@@ -1,0 +1,98 @@
+"""Headline benchmark: tokens/sec/chip, GPT-2-125M-class @ seq 2048
+(BASELINE.json metric), full training step (fwd+bwd+AdamW), bf16.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` compares against the only empirical anchor the reference
+publishes: 6,380 tokens/s/GPU — measured on its ~8.05B model on a GH200
+(BASELINE.md), not on this 125M config, so the ratio is an anchor, not an
+apples-to-apples speedup.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_TOKENS_PER_SEC = 6380.0  # BASELINE.md throughput row
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+    from fault_tolerant_llm_training_tpu.parallel.mesh import make_mesh, use_mesh
+    from fault_tolerant_llm_training_tpu.parallel.sharding import (
+        batch_pspec,
+        param_pspecs,
+    )
+    from fault_tolerant_llm_training_tpu.training.state import TrainState
+    from fault_tolerant_llm_training_tpu.training.step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    on_tpu = jax.default_backend() != "cpu"
+    seq = 2048
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "1"))
+    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "3"))
+    warmup = 3 if on_tpu else 1
+
+    cfg = get_config("gpt2-125m", vocab_size=50257, seq_len=seq,
+                     attention_impl=os.environ.get("BENCH_ATTN", "auto"))
+    mesh = make_mesh()  # all local devices on the data axis
+    n_chips = len(mesh.devices.flatten())
+
+    with use_mesh(mesh):
+        model = Transformer(cfg)
+        opt = make_optimizer(3e-4, warmup_steps=10)
+
+        def init_fn(key):
+            params = model.init(key, jnp.zeros((1, seq), jnp.int32))["params"]
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt.init(params))
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        specs = param_pspecs(abstract)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+        step_fn = jax.jit(make_train_step(model, opt, 1.0),
+                          donate_argnums=(0,),
+                          out_shardings=(shardings, None))
+
+        rng = np.random.default_rng(0)
+        bsh = NamedSharding(mesh, batch_pspec())
+        toks = jax.device_put(
+            rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32), bsh)
+        labels = jnp.concatenate(
+            [toks[:, 1:], jnp.full((batch, 1), -100, jnp.int32)], axis=1)
+
+        for _ in range(warmup):
+            state, metrics = step_fn(state, toks, labels)
+        jax.block_until_ready(state)
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, toks, labels)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    per_chip = tokens_per_sec / n_chips
+    print(json.dumps({
+        "metric": "tokens/sec/chip (GPT-2-125M-class, seq 2048, bf16, "
+                  f"bs {batch}, full train step, backend {jax.default_backend()})",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

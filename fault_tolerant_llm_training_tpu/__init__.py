@@ -1,0 +1,23 @@
+"""TPU-native fault-tolerant LLM training framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+``danilodjor/fault-tolerant-llm-training`` (a Slurm-driven, signal-based
+fault-tolerant PyTorch pretraining loop), re-designed TPU-first:
+
+- model: Flax Llama-style decoder-only transformer (ref: model.py:9-380)
+- data: streaming Parquet pipeline with checkpointable iterator state
+  (ref: dataset.py:10-101)
+- training: a single jitted ``train_step`` over a ``jax.sharding.Mesh``
+  (ref: train.py:92-117 hot loop)
+- fault tolerance: USR1/SIGTERM signal protocol, error classification,
+  checkpoint + self-resubmit (ref: utils.py:65-97, train.sh:12)
+- checkpointing: async sharded Orbax with atomic commit
+  (ref: utils.py:74-81 single-file torch.save)
+- parallelism: DP / FSDP / TP via NamedSharding + sequence parallelism via
+  ring attention (reference has none; required for TPU-pod scale)
+
+The distribution name is ``fault-tolerant-llm-training_tpu``; this package is
+its importable form.
+"""
+
+__version__ = "0.1.0"

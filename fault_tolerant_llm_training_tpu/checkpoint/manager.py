@@ -1,0 +1,83 @@
+"""Orbax checkpoint manager (ref: utils.py:74-81 save; train.py:20-24 load).
+
+The reference writes one monolithic ``torch.save`` dict named
+``checkpoint_{JOBID}.ckpt`` (45 GB, 33.6 s, single writer — BASELINE.md) and
+reconstructs the data position by replaying N batches (train.py:36-39). The
+TPU-native design:
+
+- **sharded, async** Orbax writes: every host writes its own param shards in
+  parallel; training can continue while the write drains (periodic saves),
+  and fault-path saves block only until commit;
+- **atomic commit**: Orbax finalizes a step directory only after all shards
+  land, fixing the reference's truncation race (a SIGTERM during the 33 s
+  torch.save leaves a corrupt file — SURVEY.md §5.3);
+- **data-iterator state saved in-band** (JSON), so resume is O(1) instead of
+  O(steps) replay;
+- directory layout keeps the reference's job-id naming contract:
+  ``{checkpoint_path}/checkpoint_{JOBID}/{step}/...`` — the chained job passes
+  the previous job's id exactly like ``sbatch train.sh $JOBID``
+  (ref: train.sh:24-27, utils.py:84).
+"""
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, checkpoint_path: str, job_id: str,
+                 enable_async: bool = True, max_to_keep: int = 2):
+        self.directory = os.path.join(
+            os.path.abspath(checkpoint_path), f"checkpoint_{job_id}")
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=enable_async,
+            create=True,
+        )
+        self._mngr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, data_state: dict,
+             wait: bool = False) -> int:
+        """Async sharded save of the TrainState + data-iterator position.
+        ``wait=True`` blocks until the atomic commit (fault path)."""
+        jax.block_until_ready(state)
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                data=ocp.args.JsonSave(data_state),
+            ),
+        )
+        if wait:
+            self._mngr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, abstract_state: Any,
+                step: Optional[int] = None) -> Tuple[Any, dict, int]:
+        """Restore (state, data_state, step). ``abstract_state`` is a
+        ShapeDtypeStruct pytree (with shardings) from ``jax.eval_shape`` —
+        params land directly as sharded device arrays on the current mesh,
+        the equivalent of the reference's cpu-load + load_state_dict
+        (train.py:22,56-58) without the host bounce."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps in {self.directory}")
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state),
+                data=ocp.args.JsonRestore(),
+            ),
+        )
+        return restored["state"], restored["data"], step
+
+    def wait_until_finished(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()

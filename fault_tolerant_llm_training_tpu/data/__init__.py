@@ -1,0 +1,13 @@
+from .tokenizer import ByteTokenizer, load_tokenizer
+from .parquet import ParquetDataset, IterableParquetDataset
+from .collator import CollatorForCLM
+from .loader import DataLoader
+
+__all__ = [
+    "ByteTokenizer",
+    "load_tokenizer",
+    "ParquetDataset",
+    "IterableParquetDataset",
+    "CollatorForCLM",
+    "DataLoader",
+]

@@ -1,0 +1,28 @@
+"""CLM collation (ref: dataset.py:38-53).
+
+Stacks ``seq_len + 1``-long id lists to (B, S+1), shifts into inputs/labels,
+and masks padding labels with -100 — byte-identical semantics to the
+reference's ``CollatorForCLM``, producing numpy int32 (device transfer happens
+in the prefetcher, not here).
+"""
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CollatorForCLM:
+    sequence_length: int
+    pad_token_id: int
+
+    def __call__(self, examples: List[Dict]) -> Tuple[np.ndarray, np.ndarray]:
+        input_ids = np.asarray([e["input_ids"] for e in examples],
+                               dtype=np.int32)  # (B, S+1)
+        inputs = input_ids[:, :-1].copy()
+        labels = input_ids[:, 1:].copy()
+        labels[labels == self.pad_token_id] = -100
+        assert inputs.shape[1] == labels.shape[1] == self.sequence_length
+        assert inputs.shape == labels.shape
+        return inputs, labels

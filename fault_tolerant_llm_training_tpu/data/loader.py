@@ -1,0 +1,51 @@
+"""Batching iterator over the datasets (the reference uses
+``torch.utils.data.DataLoader`` with default workers — ref: train.py:31-34).
+
+Yields ``(inputs, labels)`` numpy batches; delegates position state to the
+underlying dataset so the loader itself is checkpointable. Device transfer /
+double buffering lives in ``prefetch.py``.
+"""
+
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+from .collator import CollatorForCLM
+from .parquet import IterableParquetDataset, ParquetDataset
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size: int, collator: CollatorForCLM = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collator = collator
+        self._iter = None
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        self._iter = iter(self.dataset)  # rewinds the packed dataset
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._iter is None:
+            self.resume()
+        if isinstance(self.dataset, ParquetDataset):
+            examples = [next(self._iter) for _ in range(self.batch_size)]
+            return self.collator(examples)
+        # packed path: items are already (inputs, labels) pairs
+        pairs = [next(self._iter) for _ in range(self.batch_size)]
+        inputs = np.stack([p[0] for p in pairs])
+        labels = np.stack([p[1] for p in pairs])
+        return inputs, labels
+
+    def resume(self) -> None:
+        """Continue from the dataset's current (possibly restored) position
+        without resetting it — unlike ``__iter__`` which rewinds the packed
+        dataset (ref: dataset.py:68-72)."""
+        self._iter = self.dataset  # both datasets are self-iterators
+
+    def get_state(self) -> Dict:
+        return self.dataset.get_state()
+
+    def set_state(self, state: Dict) -> None:
+        self.dataset.set_state(state)
+        self.resume()

@@ -1,0 +1,156 @@
+"""Parquet streaming datasets with checkpointable iterator state.
+
+Two paths, mirroring the reference (ref: dataset.py:10-101):
+
+- ``ParquetDataset``       — map-style, one document per sample, padded /
+                             truncated to seq_len+1 (ref: dataset.py:10-35).
+                             This is the path the reference trainer uses.
+- ``IterableParquetDataset`` — token-buffer document packing
+                             (ref: dataset.py:56-101).
+
+Key upgrade over the reference (SURVEY.md §5.4 build note): both datasets
+expose ``get_state() / set_state()`` so the *data position is saved in the
+checkpoint* — resume is O(1) instead of the reference's O(steps) batch replay
+(ref: train.py:36-39, measured at ~9 s per 427 batches in BASELINE.md).
+
+The reference's packing has two quirks (SURVEY.md §2.1 #8): the token buffer
+is cleared at the top of every ``__next__`` (dataset.py:78), dropping overflow
+tokens, and ``current_index -= 1`` (dataset.py:93) re-reads the last document
+from its beginning for the next sample. ``legacy=True`` (default) reproduces
+both for behavioral parity; ``legacy=False`` keeps the leftover buffer and
+advances monotonically.
+"""
+
+from typing import Dict, Tuple
+
+import numpy as np
+import pyarrow.parquet as pq
+
+
+class _ParquetText:
+    """Memory-mapped 'text' column access (ref: dataset.py:18,28)."""
+
+    def __init__(self, parquet_file: str):
+        self.table = pq.read_table(parquet_file, memory_map=True)
+        self.real_length = len(self.table)
+        self._column = self.table["text"]
+
+    def __len__(self) -> int:
+        return self.real_length
+
+    def text(self, idx: int) -> str:
+        return str(self._column[idx % self.real_length])
+
+
+class ParquetDataset:
+    """Map-style: doc -> tokenize -> pad/truncate to seq_len+1
+    (ref: dataset.py:10-35). ``__len__`` is the *requested* sample count with
+    wraparound indexing (ref: dataset.py:24-28)."""
+
+    def __init__(self, parquet_file: str, tokenizer, sequence_length: int,
+                 training_samples: int):
+        self._source = _ParquetText(parquet_file)
+        self.tokenizer = tokenizer
+        self.sequence_length = sequence_length
+        self.training_samples = training_samples
+        self._next_index = 0
+
+    def __len__(self) -> int:
+        return self.training_samples
+
+    def __getitem__(self, idx: int) -> Dict:
+        return self.tokenizer.encode_plus(
+            self._source.text(idx),
+            max_length=self.sequence_length + 1,
+            padding="max_length",
+            truncation=True,
+            padding_side="right",
+        )
+
+    # --- sequential iteration with explicit, checkpointable position ---
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict:
+        if self._next_index >= self.training_samples:
+            raise StopIteration
+        item = self[self._next_index]
+        self._next_index += 1
+        return item
+
+    def get_state(self) -> Dict:
+        return {"kind": "map", "next_index": self._next_index}
+
+    def set_state(self, state: Dict) -> None:
+        assert state["kind"] == "map", state
+        self._next_index = int(state["next_index"])
+
+
+class IterableParquetDataset:
+    """Token-buffer packing (ref: dataset.py:56-101), checkpointable.
+
+    Yields ``(inputs, labels)`` int32 arrays of length seq_len; labels mask
+    BOS positions with -100 where either the input or the label is BOS
+    (ref: dataset.py:99-100).
+    """
+
+    def __init__(self, parquet_file: str, tokenizer, sequence_length: int,
+                 bos_token_id: int = 1, legacy: bool = True):
+        self._source = _ParquetText(parquet_file)
+        self.tokenizer = tokenizer
+        self.sequence_length = sequence_length
+        self.bos_token_id = bos_token_id
+        self.legacy = legacy
+        self.current_index = 0
+        self.token_buffer = []
+
+    def __iter__(self):
+        # Reset position on fresh iteration (ref: dataset.py:68-72).
+        self.token_buffer = []
+        self.current_index = 0
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        need = self.sequence_length + 1
+        if self.legacy:
+            # ref quirk: buffer cleared every sample (dataset.py:78)
+            self.token_buffer = []
+        while len(self.token_buffer) < need:
+            # Legacy truncates each document to seq_len+1 (ref:
+            # dataset.py:86-88) — combined with the buffer clear this drops
+            # the tail of every long document. Fixed mode packs whole docs.
+            tokens = self.tokenizer.encode_plus(
+                self._source.text(self.current_index),
+                padding=False,
+                truncation=self.legacy,
+                max_length=need if self.legacy else None,
+            )
+            self.token_buffer.extend(tokens["input_ids"])
+            self.current_index += 1
+        if self.legacy:
+            # ref quirk: last doc re-read from its start next time
+            # (dataset.py:93)
+            self.current_index -= 1
+            chunk = self.token_buffer[:need]
+        else:
+            chunk, self.token_buffer = (self.token_buffer[:need],
+                                        self.token_buffer[need:])
+        arr = np.asarray(chunk, dtype=np.int32)
+        inputs, labels = arr[:-1].copy(), arr[1:].copy()
+        labels[inputs == self.bos_token_id] = -100
+        labels[labels == self.bos_token_id] = -100
+        return inputs, labels
+
+    def get_state(self) -> Dict:
+        return {
+            "kind": "packed",
+            "current_index": self.current_index,
+            "token_buffer": [int(t) for t in self.token_buffer],
+            "legacy": self.legacy,
+        }
+
+    def set_state(self, state: Dict) -> None:
+        assert state["kind"] == "packed", state
+        self.current_index = int(state["current_index"])
+        self.token_buffer = list(state["token_buffer"])
+        self.legacy = bool(state["legacy"])

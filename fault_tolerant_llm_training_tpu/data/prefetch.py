@@ -1,0 +1,84 @@
+"""Host -> device double-buffered prefetch.
+
+The reference has *no* prefetch: a synchronous ``.to(device)`` per step with
+``num_workers=0`` tokenization on the critical path (ref: train.py:93-96,
+dataset.py:27-35; SURVEY.md §5.8 flags this as the gap). Here a background
+thread tokenizes/collates ahead while ``jax.device_put`` (async under the
+hood) stages batches into HBM with the batch's NamedSharding, so the TPU never
+waits on the host in steady state.
+
+Checkpoint correctness under prefetch: the loader's position runs ``depth``
+batches ahead of what the trainer has consumed, so each queued batch carries
+the loader-state snapshot taken *right after* it was produced. The trainer
+checkpoints the snapshot of the last batch it actually consumed — restoring
+that state resumes at exactly the first unconsumed batch, prefetch depth
+notwithstanding.
+"""
+
+import queue
+import threading
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class DevicePrefetcher:
+    """Wraps a DataLoader; yields ``(inputs_dev, labels_dev, data_state)``."""
+
+    def __init__(self, loader, sharding=None, depth: int = 2):
+        self.loader = loader
+        self.sharding = sharding
+        self.depth = max(1, depth)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self._started = False
+
+    def _stage(self, arr: np.ndarray):
+        if self.sharding is not None:
+            return jax.device_put(arr, self.sharding)
+        return jax.device_put(np.asarray(arr))
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    inputs, labels = next(self.loader)
+                except StopIteration:
+                    break
+                state = self.loader.get_state()
+                self._q.put((self._stage(inputs), self._stage(labels), state))
+        except BaseException as e:  # surfaced to the consumer
+            self._exc = e
+        finally:
+            self._q.put(None)
+
+    def __iter__(self):
+        if not self._started:
+            self._started = True
+            self.loader.resume()
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+        return self
+
+    def __next__(self) -> Tuple[jax.Array, jax.Array, dict]:
+        if not self._started:
+            iter(self)
+        item = self._q.get()
+        if item is None:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def stop(self):
+        """Stop the background thread and drain the queue (used on fault
+        exits so the checkpoint write is not racing tokenization)."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass

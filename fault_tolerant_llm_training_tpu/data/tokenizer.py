@@ -1,0 +1,69 @@
+"""Tokenizer loading with an offline-safe fallback.
+
+The reference hard-depends on a Hugging Face hub tokenizer
+(``unsloth/Mistral-Nemo-Base-2407-bnb-4bit``, ref: utils.py:133-137,
+train.py:28) — which requires network or a warm cache. TPU pods frequently run
+with no egress, so this framework adds a first-party ``ByteTokenizer``
+(UTF-8 bytes + BOS/EOS/PAD specials) selectable as
+``--tokenizer-name-or-path byte`` and used as an automatic fallback when the
+HF tokenizer cannot be loaded offline.
+
+Only the tokenizer surface the reference actually uses is required:
+``encode_plus(text, max_length=, padding=, truncation=, padding_side=)``
+returning ``{"input_ids": [...]}`` (ref: dataset.py:29-35,84-89), plus
+``vocab_size`` / ``pad_token_id`` / ``bos_token_id`` / ``decode``
+(ref: train.py:30,51; dataset.py:58,122).
+"""
+
+import logging
+from typing import Dict, List
+
+logger = logging.getLogger()
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..2 = PAD/BOS/EOS, 3..258 = bytes."""
+
+    pad_token_id = 0
+    bos_token_id = 1
+    eos_token_id = 2
+    _OFFSET = 3
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self._OFFSET
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + self._OFFSET for b in text.encode("utf-8")]
+        return ([self.bos_token_id] + ids) if add_bos else ids
+
+    def encode_plus(self, text: str, max_length: int = None, padding=False,
+                    truncation: bool = False, padding_side: str = "right"
+                    ) -> Dict[str, List[int]]:
+        ids = self.encode(text)
+        if truncation and max_length is not None:
+            ids = ids[:max_length]
+        if padding == "max_length" and max_length is not None:
+            pad = [self.pad_token_id] * (max_length - len(ids))
+            ids = (ids + pad) if padding_side == "right" else (pad + ids)
+        return {"input_ids": ids}
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) - self._OFFSET for i in ids
+                     if int(i) >= self._OFFSET)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_tokenizer(name_or_path: str):
+    """HF tokenizer by name/path, or ByteTokenizer for 'byte' / offline."""
+    if name_or_path in ("byte", "byte://", ""):
+        return ByteTokenizer()
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(name_or_path)
+    except Exception as e:  # offline, missing cache, bad name, ...
+        logger.warning(
+            "Could not load HF tokenizer %r (%s); falling back to the "
+            "built-in byte tokenizer", name_or_path, type(e).__name__)
+        return ByteTokenizer()

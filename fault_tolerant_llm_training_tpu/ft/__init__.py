@@ -1,0 +1,4 @@
+from .signals import SignalFlag, TrainingSignal
+from .handler import handle_exit, classify_exception
+
+__all__ = ["SignalFlag", "TrainingSignal", "handle_exit", "classify_exception"]

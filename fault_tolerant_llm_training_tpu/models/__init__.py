@@ -1,0 +1,4 @@
+from .configs import TransformerConfig, PRESETS, get_config
+from .llama import Transformer
+
+__all__ = ["TransformerConfig", "PRESETS", "get_config", "Transformer"]

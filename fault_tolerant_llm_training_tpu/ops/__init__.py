@@ -1,0 +1,4 @@
+from .rope import precompute_rope, apply_rope
+from .attention import multihead_attention
+
+__all__ = ["precompute_rope", "apply_rope", "multihead_attention"]

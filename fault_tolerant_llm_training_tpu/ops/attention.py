@@ -1,0 +1,68 @@
+"""Causal multi-head attention dispatch.
+
+The reference delegates the attention kernel to the container's fused
+``F.scaled_dot_product_attention(is_causal=True)`` (ref: model.py:212) after
+expanding GQA KV heads with ``repeat_kv`` (ref: model.py:129-138,204-205).
+On TPU the equivalents are:
+
+- ``xla``    — einsum attention with fp32 softmax; XLA fuses it well and it is
+               the portable (CPU-testable) reference semantics.
+- ``pallas`` — the Pallas flash-attention kernel (ops/flash_attention.py),
+               tiled for the MXU, O(S) memory.
+- ``ring``   — sequence-parallel ring attention (ops/ring_attention.py) for
+               long contexts sharded over the mesh's 'sequence' axis.
+- ``auto``   — pallas on TPU, xla elsewhere.
+
+GQA is handled *without* materializing repeated KV heads: the einsum reshapes
+Q to (B, S, K, G, D) — K kv-groups of G = n_heads // n_kv_heads query heads —
+so KV stay at their native head count (the repeat in the reference exists only
+because SDPA requires matching head counts; on TPU it would waste HBM
+bandwidth).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _causal_mask(s_q: int, s_k: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Additive causal mask (s_q, s_k); query i attends keys <= i (+ offset)."""
+    q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+    k_pos = jnp.arange(s_k)[None, :]
+    return jnp.where(k_pos <= q_pos, 0.0, jnp.finfo(dtype).min).astype(dtype)
+
+
+def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = True) -> jnp.ndarray:
+    """Grouped-query causal attention, fp32 softmax, einsum formulation.
+
+    q: (B, S, H, D); k, v: (B, S, K, D) with H % K == 0.
+    Matches the reference kernel semantics (model.py:204-212) — softmax over
+    keys in fp32, scale 1/sqrt(D) — without the repeat_kv copy.
+    """
+    b, s_q, h, d = q.shape
+    _, s_k, kv, _ = k.shape
+    g = h // kv
+    qg = q.reshape(b, s_q, kv, g, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    # scores: (B, K, G, S_q, S_k)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        scores = scores + _causal_mask(s_q, s_k)[None, None, None, :, :]
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s_q, h, d).astype(q.dtype)
+
+
+def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        impl: str = "auto", causal: bool = True) -> jnp.ndarray:
+    """Dispatch to the requested attention implementation."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal)
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    raise ValueError(f"unknown attention impl: {impl!r}")

@@ -1,0 +1,129 @@
+"""Pallas TPU flash attention (causal, GQA-aware).
+
+TPU-native replacement for the reference's fused-kernel dependency
+``F.scaled_dot_product_attention(is_causal=True)`` (ref: model.py:212), which
+on CUDA comes from the NGC container. Here the kernel is first-party:
+an online-softmax tiled forward that never materializes the (S, S) score
+matrix — O(S) memory, q-tiles streamed through VMEM, scores computed on the
+MXU in fp32.
+
+The backward pass currently recomputes attention through the XLA einsum path
+(same math, exact gradients, no saved probabilities); a Pallas backward kernel
+is the planned upgrade.
+
+GQA: the kernel maps query head ``h`` to KV head ``h // (H // K)`` in the
+BlockSpec index map — KV are never repeated in memory (the reference's
+``repeat_kv`` at model.py:129-138 materializes the expansion).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+                causal: bool):
+    # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D)
+    q = q_ref[0, 0]
+    block_q, d = q.shape
+    s_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    if causal:
+        # Only k-blocks whose start is <= the last query position matter.
+        num_k_blocks = jnp.minimum(
+            (q_start + block_q + block_k - 1) // block_k, s_k // block_k)
+    else:
+        num_k_blocks = s_k // block_k
+
+    def body(j, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_start = j * block_k
+        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        v = v_ref[0, 0, pl.ds(k_start, block_k), :]
+        acc_new = acc_prev * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    init = (jnp.full((block_q,), NEG_INF, jnp.float32),
+            jnp.zeros((block_q,), jnp.float32),
+            jnp.zeros((block_q, d), jnp.float32))
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, init)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    # (B, S, H, D) -> (B, H, S, D) so heads become a grid axis.
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    b, h, s, d = qt.shape
+    kv_heads = kt.shape[1]
+    group = h // kv_heads
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (
+        f"seq len {s} must be divisible by block sizes ({block_q}, {block_k})")
+    scale = 1.0 / (d ** 0.5)
+
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
+                               causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal=True):
+    """Causal flash attention; q (B,S,H,D), k/v (B,S,K,D) -> (B,S,H,D)."""
+    interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                      interpret)
+
+
+def _flash_attention_fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal), (q, k, v)
+
+
+def _flash_attention_bwd(causal, residuals, g):
+    from .attention import xla_attention
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: xla_attention(q_, k_, v_, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

@@ -1,0 +1,104 @@
+"""Sequence-parallel causal ring attention (long-context support).
+
+The reference has no long-context machinery (SURVEY.md §5.7) — full causal
+SDPA bounded by one GPU's memory. This is the TPU-native scale-out path: the
+sequence is sharded over the mesh's 'sequence' axis, each device computes
+online-softmax partial attention for its query block while KV blocks rotate
+around the ring via ``lax.ppermute`` over ICI, overlapping compute with
+neighbor exchange. Memory per device is O(S / sp); no (S, S) score matrix
+ever exists.
+
+Causality without wasted work: device ``i`` starts with its own KV block
+(the diagonal, causal-masked), then receives blocks ``i-1, i-2, ...``; blocks
+from the future are fully masked and contribute nothing to the softmax
+accumulators.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import active_mesh
+
+try:  # jax >= 0.6 exposes shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+NEG_INF = -1e30
+
+
+def _local_update(qg, k_blk, v_blk, m, l, acc, q_pos, k_pos, scale):
+    """One online-softmax accumulation of q against a single KV block."""
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bkgqs,bskd->bkgqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _ring_local(q, k, v, *, sp: int, axis_name: str):
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    kv_heads = k.shape[2]
+    g = h // kv_heads
+    scale = 1.0 / (d ** 0.5)
+    qg = q.reshape(b, s_loc, kv_heads, g, d)
+    q_pos = my * s_loc + jnp.arange(s_loc)
+
+    m = jnp.full((b, kv_heads, g, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, kv_heads, g, s_loc), jnp.float32)
+    acc = jnp.zeros((b, kv_heads, g, s_loc, d), jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    k_blk, v_blk = k, v
+    for t in range(sp):
+        src = (my - t) % sp  # which global block this device holds at step t
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        m, l, acc = _local_update(qg, k_blk, v_blk, m, l, acc, q_pos, k_pos,
+                                  scale)
+        if t + 1 < sp:
+            k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
+
+    out = acc / l[..., None]  # (b, kv, g, s_loc, d)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s_loc, h, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str = "sequence", mesh=None
+                   ) -> jax.Array:
+    """Causal GQA attention with the sequence dim sharded over ``axis_name``.
+
+    q: (B, S, H, D); k/v: (B, S, K, D) — global (jit) view; internally a
+    shard_map over the active mesh rotates KV blocks around the ring.
+    """
+    mesh = mesh or active_mesh()
+    if mesh is None or mesh.shape[axis_name] == 1:
+        from .attention import xla_attention
+        return xla_attention(q, k, v, causal=True)
+    sp = mesh.shape[axis_name]
+    # Degrade per-axis when a dim is not divisible by its mesh axes (e.g. the
+    # batch-1 dummy used by model.init): shard_map then replicates that dim,
+    # which is always semantically valid.
+    dp_total = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    tp = mesh.shape.get("tensor", 1)
+    batch_axes = ("data", "fsdp") if q.shape[0] % dp_total == 0 else None
+    head_axis = ("tensor"
+                 if q.shape[2] % tp == 0 and k.shape[2] % tp == 0 else None)
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = _shard_map(
+        functools.partial(_ring_local, sp=sp, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,20 @@
+from .mesh import MESH_AXES, make_mesh, active_mesh, use_mesh
+from .sharding import (
+    LOGICAL_RULES,
+    batch_pspec,
+    constrain,
+    param_pspecs,
+    param_shardings,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "make_mesh",
+    "active_mesh",
+    "use_mesh",
+    "LOGICAL_RULES",
+    "batch_pspec",
+    "constrain",
+    "param_pspecs",
+    "param_shardings",
+]

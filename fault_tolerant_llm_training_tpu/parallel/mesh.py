@@ -1,0 +1,76 @@
+"""Device mesh construction (reference has no parallelism — SURVEY.md §2.3;
+this is the build's first-class replacement for the NCCL/DDP layer the
+reference would have needed at scale).
+
+Mesh axes, in order:
+
+- ``data``     — pure data parallelism (gradient psum over ICI)
+- ``fsdp``     — parameter/optimizer sharding; also shards the batch
+- ``sequence`` — sequence/context parallelism (ring attention)
+- ``tensor``   — tensor parallelism (Megatron-style sharded matmuls)
+
+Collectives are inserted by XLA from the NamedShardings; on a real pod the
+axes should be laid out so that ``tensor``/``sequence`` ride ICI and ``data``
+can span DCN (the axis order here puts the fast-varying axes last, which maps
+them to nearby devices in the default device order).
+"""
+
+import contextlib
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("data", "fsdp", "sequence", "tensor")
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def make_mesh(dp: int = -1, fsdp: int = 1, sp: int = 1, tp: int = 1,
+              devices=None) -> Mesh:
+    """Build a ('data','fsdp','sequence','tensor') mesh; dp=-1 fills devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    denom = fsdp * sp * tp
+    if dp == -1:
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by fsdp*sp*tp={denom}")
+        dp = n // denom
+    total = dp * denom
+    if total > n:
+        raise ValueError(f"mesh {dp}x{fsdp}x{sp}x{tp}={total} exceeds {n} devices")
+    arr = np.asarray(devices[:total]).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    """Install ``mesh`` as the process-wide active mesh.
+
+    Model code resolves activation sharding constraints (and ring attention
+    its axis) against this; ``None`` or a trivial 1-device mesh disables
+    constraints so the same model code runs unsharded on CPU."""
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        if mesh is not None:
+            with mesh:
+                yield mesh
+        else:
+            yield None
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def mesh_axis_size(axis: str) -> int:
+    mesh = active_mesh()
+    if mesh is None:
+        return 1
+    return mesh.shape[axis]

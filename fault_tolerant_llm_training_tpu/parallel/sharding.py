@@ -1,0 +1,127 @@
+"""Sharding rules: logical axes -> mesh axes, and param-path -> logical axes.
+
+This is the build's FSDP/TP layer (SURVEY.md §2.3: the reference has none; the
+BASELINE.json north star requires DP psum + pjit/NamedSharding FSDP). Instead
+of boxing Flax params in metadata, shardings are derived from the parameter
+tree *path* with regex rules — transparent, testable, and Orbax-friendly.
+
+Logical activation/parameter axes:
+
+- batch -> ('data', 'fsdp')   (FSDP also shards the batch)
+- seq   -> 'sequence'         (ring attention shards)
+- vocab -> 'tensor'
+- embed -> 'fsdp'             (FSDP shards params along their embed dim)
+- heads -> 'tensor'           (Megatron: split attention heads)
+- mlp   -> 'tensor'           (Megatron: split SwiGLU hidden)
+- norm  -> None               (tiny vectors, replicated)
+
+With this single rule set, FSDP-only meshes (tp=1) shard every matrix over
+'fsdp' on its embed dim, TP-only meshes split heads/mlp/vocab, and combined
+meshes do both — XLA inserts all-gathers / reduce-scatters / psums from the
+NamedShardings (the scaling-book recipe).
+"""
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import active_mesh
+
+LOGICAL_RULES: Dict[str, object] = {
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "vocab": "tensor",
+    "embed": "fsdp",
+    # activations keep their feature dim replicated (FSDP shards params, not
+    # activations; 'embed' -> fsdp applies to parameter matrices only)
+    "act_embed": None,
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "mlp": "tensor",
+    "norm": None,
+}
+
+# Parameter-path (joined with '/') -> logical axes of that parameter.
+PARAM_AXIS_RULES: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = (
+    (r"tok_embeddings/embedding$", ("vocab", "embed")),
+    (r"wq/kernel$", ("embed", "heads")),
+    (r"wk/kernel$", ("embed", "kv_heads")),
+    (r"wv/kernel$", ("embed", "kv_heads")),
+    (r"wo/kernel$", ("heads", "embed")),
+    (r"w1/kernel$", ("embed", "mlp")),
+    (r"w3/kernel$", ("embed", "mlp")),
+    (r"w2/kernel$", ("mlp", "embed")),
+    (r"output/kernel$", ("embed", "vocab")),
+    (r"(scale|norm)[^/]*$", ("norm",)),
+)
+
+
+def _resolve(logical_axes, rules=None) -> P:
+    rules = LOGICAL_RULES if rules is None else rules
+    return P(*(rules.get(a) if a is not None else None for a in logical_axes))
+
+
+def logical_pspec(*logical_axes) -> P:
+    return _resolve(logical_axes)
+
+
+def batch_pspec() -> P:
+    """Batches: (B, S) sharded batch->data+fsdp, seq->sequence."""
+    return _resolve(("batch", "seq"))
+
+
+def constrain(x: jax.Array, *logical_axes) -> jax.Array:
+    """``with_sharding_constraint`` against the active mesh; no-op without one.
+
+    Axes whose mesh axis has size 1 still resolve fine (XLA treats them as
+    unsharded), so the same model code traces identically on a laptop CPU and
+    a v5p-64 mesh."""
+    mesh = active_mesh()
+    if mesh is None or len(logical_axes) != x.ndim:
+        return x
+    spec = _resolve(logical_axes)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def param_pspecs(params) -> dict:
+    """PartitionSpec pytree for a param pytree, from PARAM_AXIS_RULES paths."""
+
+    def spec_for(path: str, leaf) -> P:
+        for pattern, axes in PARAM_AXIS_RULES:
+            if re.search(pattern, path):
+                if len(axes) != leaf.ndim:
+                    raise ValueError(
+                        f"rule {pattern!r} gives {len(axes)} axes for {path} "
+                        f"with ndim {leaf.ndim}")
+                return _resolve(axes)
+        return P(*([None] * leaf.ndim))  # replicate unknown params
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    specs = {}
+    for keypath, leaf in flat[0]:
+        path = "/".join(_key_str(k) for k in keypath)
+        specs[path] = spec_for(path, leaf)
+    return jax.tree_util.tree_unflatten(
+        flat[1], [specs["/".join(_key_str(k) for k in kp)] for kp, _ in flat[0]])
+
+
+def param_shardings(params, mesh=None):
+    """NamedSharding pytree for ``params`` on ``mesh`` (default: active mesh)."""
+    mesh = mesh or active_mesh()
+    if mesh is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), param_pspecs(params),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):  # DictKey
+        return str(k.key)
+    if hasattr(k, "name"):  # GetAttrKey (e.g. TrainState fields)
+        return str(k.name)
+    if hasattr(k, "idx"):  # SequenceKey (e.g. optax chain tuples)
+        return str(k.idx)
+    return str(k)

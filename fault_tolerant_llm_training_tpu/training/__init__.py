@@ -1,0 +1,6 @@
+from .state import TrainState
+from .step import cross_entropy_loss, make_optimizer, make_train_step
+from .loop import Trainer
+
+__all__ = ["TrainState", "cross_entropy_loss", "make_optimizer",
+           "make_train_step", "Trainer"]

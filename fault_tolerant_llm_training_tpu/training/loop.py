@@ -1,0 +1,262 @@
+"""Training orchestration (ref: train.py:12-129).
+
+Setup order mirrors the reference (checkpoint -> data -> model -> optimizer ->
+resume bookkeeping, ref train.py:20-84) with the TPU-native differences:
+
+- signal handlers are installed *before* setup and checked at phase
+  boundaries, closing the reference's fatal unprotected-setup window
+  (SURVEY.md §3.2);
+- resume restores the data-iterator position from the checkpoint in O(1)
+  instead of replaying N batches (ref: train.py:36-39);
+- the hot loop dispatches the jitted step asynchronously with a bounded
+  in-flight window (``--inflight``): dispatch stays pipelined (the reference
+  blocks on ``loss.item()`` every log step) while "current step" remains
+  well-defined within the 120 s preemption budget (SURVEY.md §7.3 #1);
+- a non-finite gradient norm raises on the host when the metric is consumed —
+  same fault path as the reference's ``error_if_nonfinite`` (utils.py:61),
+  shifted out of the jitted region.
+"""
+
+import collections
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ..checkpoint.manager import CheckpointManager
+from ..data.collator import CollatorForCLM
+from ..data.loader import DataLoader
+from ..data.parquet import IterableParquetDataset, ParquetDataset
+from ..data.prefetch import DevicePrefetcher
+from ..data.tokenizer import load_tokenizer
+from ..ft.signals import SignalFlag
+from ..models import Transformer, get_config
+from ..parallel.mesh import make_mesh, use_mesh
+from ..parallel.sharding import batch_pspec, param_pspecs
+from ..training.state import TrainState
+from ..training.step import make_optimizer, make_train_step
+from ..utils.config import JOBID, TrainConfig
+from ..utils.dtypes import PRECISION_STR_TO_DTYPE
+from ..utils.grad_clip import NonFiniteGradientError
+from ..utils.logging import (
+    AUDIT_RESUME_FMT,
+    AUDIT_START,
+    AUDIT_STEP_FMT,
+    logger,
+)
+from ..utils.metrics import Throughput
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, signal_flag: Optional[SignalFlag] = None):
+        self.cfg = cfg
+        self.state = None
+        self.training_step = 0
+        self._resumed = False
+        self._last_data_state = None
+        self._mesh_ctx = None
+
+        # Handlers first — signals during the (potentially long) setup are
+        # deferred and handled at the next phase boundary instead of killing
+        # the process (the reference registers only at train.py:89-90).
+        self.signal_flag = signal_flag or SignalFlag()
+        if signal_flag is None:
+            self.signal_flag.register()
+
+        logger.info(f"Experiment args: {cfg}")  # ref: train.py:14
+
+        if cfg.distributed:
+            jax.distributed.initialize()
+
+        self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp)
+        self._mesh_ctx = use_mesh(self.mesh)
+        self._mesh_ctx.__enter__()
+
+        # Resume source (ref: train.py:20-24): the chained job passes the
+        # *previous* job's id; its checkpoints live in checkpoint_{id}/.
+        read_mngr = None
+        if cfg.checkpoint_id:
+            logger.info(f"Loading checkpoint from {cfg.checkpoint_path}")
+            read_mngr = CheckpointManager(cfg.checkpoint_path, cfg.checkpoint_id)
+        self.signal_flag.check()
+
+        # --- data (ref: train.py:27-34) ---
+        logger.info("Setting up DataLoaders...")
+        self.tokenizer = load_tokenizer(cfg.tokenizer_name_or_path)
+        if cfg.data_loading == "map":
+            dataset = ParquetDataset(cfg.dataset, self.tokenizer,
+                                     cfg.sequence_length,
+                                     cfg.batch_size * cfg.training_steps)
+            collator = CollatorForCLM(cfg.sequence_length,
+                                      self.tokenizer.pad_token_id)
+            self.loader = DataLoader(dataset, cfg.batch_size, collator)
+        else:
+            dataset = IterableParquetDataset(
+                cfg.dataset, self.tokenizer, cfg.sequence_length,
+                bos_token_id=self.tokenizer.bos_token_id,
+                legacy=cfg.legacy_packing)
+            self.loader = DataLoader(dataset, cfg.batch_size)
+        self.signal_flag.check()
+
+        # --- model + optimizer (ref: train.py:42-77) ---
+        logger.info("Setting up Model...")
+        dtype = PRECISION_STR_TO_DTYPE[cfg.model_dtype]
+        param_dtype = (jnp.float32 if cfg.master_weights == "fp32" else dtype)
+        vocab = cfg.vocab_size or self.tokenizer.vocab_size
+        self.model_config = get_config(
+            cfg.model, vocab_size=vocab, seq_len=cfg.sequence_length,
+            dtype=dtype, param_dtype=param_dtype,
+            attention_impl=cfg.attention_impl, remat=cfg.remat)
+        self.model = Transformer(self.model_config)
+        self.optimizer = make_optimizer(cfg.learning_rate, cfg.lr_warmup_steps)
+
+        dummy = jnp.zeros((1, cfg.sequence_length), jnp.int32)
+
+        def init_fn(key):
+            params = self.model.init(key, dummy)["params"]
+            opt_state = self.optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state)
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(cfg.seed))
+        specs = param_pspecs(abstract)
+        self.state_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        self.abstract_state = jax.tree_util.tree_map(
+            lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            abstract, self.state_shardings)
+        abstract_sharded = self.abstract_state
+
+        if read_mngr is not None:
+            self.state, data_state, _ = read_mngr.restore(abstract_sharded)
+            read_mngr.close()
+            self.loader.set_state(data_state)
+            self.training_step = int(self.state.step)
+            self._last_data_state = data_state
+            self._resumed = True
+            logger.info("Model loaded from checkpoint")  # ref: train.py:58
+            logger.info("Optimizer loaded from checkpoint")  # ref: train.py:72
+            logger.info("LR Scheduler loaded from checkpoint")  # ref: train.py:77
+        else:
+            self.state = jax.jit(init_fn,
+                                 out_shardings=self.state_shardings)(
+                jax.random.PRNGKey(cfg.seed))
+            self._last_data_state = self.loader.get_state()
+        self.signal_flag.check()
+
+        # Save manager for *this* job's id (ref naming: checkpoint_{JOBID},
+        # utils.py:80) — files accumulate one dir per preemption, like the
+        # reference accumulates one .ckpt per preemption.
+        self._save_job_id = JOBID or "local"
+        self.ckpt_mngr = CheckpointManager(cfg.checkpoint_path,
+                                           self._save_job_id)
+
+        self.batch_sharding = NamedSharding(self.mesh, batch_pspec())
+        self._jit_step = jax.jit(
+            make_train_step(self.model, self.optimizer, cfg.grad_max_norm),
+            donate_argnums=(0,),
+            out_shardings=(self.state_shardings, None))
+        # AOT-compile now, inside the signal-deferred setup window: a
+        # preemption signal interrupting XLA compilation can wedge native
+        # code, and compilation is the longest uninterruptible stretch
+        # (~35 s model build in the reference, SURVEY.md §3.2).
+        batch_struct = jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.sequence_length), jnp.int32,
+            sharding=self.batch_sharding)
+        self._compiled_step = self._jit_step.lower(
+            self.abstract_state, batch_struct, batch_struct).compile()
+        self.prefetcher = DevicePrefetcher(self.loader,
+                                           sharding=self.batch_sharding,
+                                           depth=cfg.prefetch)
+        self.throughput = Throughput(
+            tokens_per_step=cfg.batch_size * cfg.sequence_length)
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> None:
+        cfg = self.cfg
+        if self._resumed:
+            # ref: train.py:81
+            logger.info(AUDIT_RESUME_FMT.format(step=self.training_step))
+        else:
+            logger.info(AUDIT_START)  # ref: train.py:84
+
+        if cfg.profile_dir:
+            jax.profiler.start_trace(cfg.profile_dir)
+        try:
+            self._loop()
+        finally:
+            if cfg.profile_dir:
+                jax.profiler.stop_trace()
+
+    def _loop(self) -> None:
+        cfg = self.cfg
+        inflight = collections.deque()
+        it = iter(self.prefetcher)
+        while self.training_step < cfg.training_steps:
+            self.signal_flag.check()
+            inputs, labels, data_state = next(it)
+            self.state, metrics = self._compiled_step(self.state, inputs,
+                                                      labels)
+            self._last_data_state = data_state
+            inflight.append((self.training_step, metrics))
+            while len(inflight) >= max(1, cfg.inflight):
+                self._consume(*inflight.popleft())
+            # Deterministic fault injection (ref: train.py:112-113): raised
+            # while the counter still equals error_step, after the update.
+            if cfg.raise_error and self.training_step == cfg.error_step:
+                while inflight:
+                    self._consume(*inflight.popleft())
+                raise Exception(
+                    "Simulated exception to test signal handler", -1)
+            self.training_step += 1
+            if (cfg.checkpoint_frequency
+                    and self.training_step % cfg.checkpoint_frequency == 0):
+                self.save_checkpoint(wait=False, stop_prefetch=False)
+        while inflight:
+            self._consume(*inflight.popleft())
+
+    def _consume(self, step_no: int, metrics: dict) -> None:
+        """Pull one step's metrics to the host (the only D2H sync point —
+        the reference syncs via loss.item() at train.py:116)."""
+        grad_norm = float(metrics["grad_norm"])
+        if not math.isfinite(grad_norm):
+            # ref: utils.py:61 error_if_nonfinite -> routed as code error (-1)
+            raise NonFiniteGradientError(
+                f"non-finite gradient norm {grad_norm} at step {step_no}")
+        self.throughput.step()
+        self.last_loss = float(metrics["loss"])
+        if step_no == 1 or step_no % self.cfg.logging_frequency == 0:
+            # ref: train.py:115-116 (exact format), plus throughput extras
+            logger.info(AUDIT_STEP_FMT.format(step=step_no,
+                                              loss=self.last_loss))
+            tps = self.throughput.tokens_per_sec
+            if tps:
+                logger.info(
+                    f"Metrics | step {step_no} | grad_norm "
+                    f"{grad_norm:.3f} | tokens/s {tps:,.0f}")
+
+    # --------------------------------------------------------------- saving
+    def save_checkpoint(self, wait: bool = True,
+                        stop_prefetch: bool = True) -> int:
+        """Checkpoint the state of every *dispatched* step plus the matching
+        data position. All dispatched XLA work completes by construction, so
+        zero steps are lost (the reference's guarantee: saved @427, resumed
+        @427 — BASELINE.md)."""
+        if stop_prefetch:
+            self.prefetcher.stop()
+        step = int(jax.device_get(self.state.step))
+        data_state = self._last_data_state or self.loader.get_state()
+        self.ckpt_mngr.save(step, self.state, data_state, wait=wait)
+        return step
+
+    def close(self) -> None:
+        self.prefetcher.stop()
+        self.ckpt_mngr.close()
+        if self._mesh_ctx is not None:
+            self._mesh_ctx.__exit__(None, None, None)
+            self._mesh_ctx = None

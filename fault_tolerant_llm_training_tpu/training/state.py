@@ -1,0 +1,19 @@
+"""Training state pytree.
+
+The reference's equivalent is the checkpoint dict
+``{model, optimizer, lr_scheduler, training_step}`` (ref: utils.py:75-80) —
+here it is a single immutable pytree threaded through the jitted step. The LR
+scheduler needs no separate state: the optax schedule is a pure function of
+the optimizer's update count.
+"""
+
+from typing import Any
+
+import jax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array  # int32 scalar; ref 'training_step' (utils.py:79)
+    params: Any  # ref 'model' state_dict
+    opt_state: Any  # ref 'optimizer' (+ the schedule count = 'lr_scheduler')

@@ -1,0 +1,16 @@
+from .dtypes import PRECISION_STR_TO_DTYPE
+from .logging import init_logger, logger
+from .schedules import linear_warmup_constant
+from .grad_clip import global_norm, clip_grads_with_norm
+from .config import get_args, TrainConfig
+
+__all__ = [
+    "PRECISION_STR_TO_DTYPE",
+    "init_logger",
+    "logger",
+    "linear_warmup_constant",
+    "global_norm",
+    "clip_grads_with_norm",
+    "get_args",
+    "TrainConfig",
+]

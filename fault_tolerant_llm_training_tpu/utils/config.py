@@ -1,0 +1,151 @@
+"""Config / flag system (ref: utils.py:112-203 + env at utils.py:11-12).
+
+Every reference flag is kept with the same name, type, and default so that the
+reference's ``TRAINING_CMD`` lines (ref: train.sh:16-27) parse unchanged.
+TPU-specific flags (mesh shape, attention impl, checkpointing cadence, ...)
+are additive.
+
+Environment contract (ref: utils.py:11-12, train.py:16):
+- ``WORKDIR``       — job working dir, used for self-resubmit (``sbatch $WORKDIR/train.sh``)
+- ``SLURM_JOB_ID``  — names the checkpoint of *this* job (``checkpoint_{JOBID}``)
+"""
+
+import argparse
+import dataclasses
+import os
+from typing import Optional
+
+WORKDIR = os.getenv("WORKDIR", "")
+JOBID = os.environ.get("SLURM_JOB_ID")
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Typed view over the parsed flags (the reference passes the raw Namespace)."""
+
+    # --- reference flags (ref: utils.py:114-201) ---
+    dataset: str = ""
+    checkpoint_path: str = ""
+    checkpoint_id: str = ""
+    tokenizer_name_or_path: str = "unsloth/Mistral-Nemo-Base-2407-bnb-4bit"
+    sequence_length: int = 4096
+    batch_size: int = 1
+    fused_optimizer: bool = False  # no-op on TPU: XLA fuses the optax update
+    learning_rate: float = 1e-5
+    lr_warmup_steps: int = 10
+    training_steps: int = 1000
+    logging_frequency: int = 5
+    grad_max_norm: float = 1.0
+    model_dtype: str = "bf16"
+    compile: bool = False  # no-op on TPU: the train step is always jitted
+    raise_error: bool = False
+    error_step: int = 100
+    # --- model selection (reference hard-codes Llama-3-8B in train.py:43-53) ---
+    model: str = "gpt2-125m"
+    vocab_size: int = 0  # 0 -> from tokenizer (ref: train.py:51)
+    # --- TPU-native additions ---
+    seed: int = 0
+    dp: int = -1  # data-parallel mesh size; -1 = fill remaining devices
+    fsdp: int = 1  # FSDP (param/optimizer sharding) mesh size
+    tp: int = 1  # tensor-parallel mesh size
+    sp: int = 1  # sequence-parallel (ring attention) mesh size
+    attention_impl: str = "auto"  # auto | xla | pallas | ring
+    remat: bool = False  # jax.checkpoint each block (trade FLOPs for HBM)
+    master_weights: str = "same"  # same | fp32 (fp32 optimizer master copy)
+    data_loading: str = "map"  # map (ParquetDataset path) | packed (iterable)
+    legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
+    checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
+    prefetch: int = 2  # host->device prefetch depth (reference has none)
+    inflight: int = 2  # max dispatched-but-unfinished steps (bounds signal latency)
+    profile_dir: str = ""  # jax.profiler trace output; "" = off
+    resubmit_command: str = ""  # override for tests; default: sbatch $WORKDIR/train.sh
+    distributed: bool = False  # call jax.distributed.initialize() (multi-host pods)
+
+
+def get_args(argv: Optional[list] = None) -> TrainConfig:
+    """Parse flags. Mirrors ref utils.py:112-203 plus TPU additions."""
+    parser = argparse.ArgumentParser(description="TPU-native fault-tolerant LLM training")
+    # --- reference flag set, names/defaults preserved (ref: utils.py:114-201) ---
+    parser.add_argument(
+        "--dataset",
+        type=str,
+        default=os.path.join(WORKDIR, "data", "train_data.parquet") if WORKDIR else "",
+        help="Path to a parquet file containing a 'text' column with documents (str)",
+    )
+    parser.add_argument(
+        "--checkpoint-path",
+        type=str,
+        default=f"{WORKDIR}/checkpoints",
+        help="Directory where checkpoints are saved/loaded",
+    )
+    parser.add_argument(
+        "--checkpoint-id",
+        type=str,
+        default="",
+        help="Job id whose checkpoint_{id} directory to resume from",
+    )
+    parser.add_argument(
+        "--tokenizer-name-or-path",
+        type=str,
+        default="unsloth/Mistral-Nemo-Base-2407-bnb-4bit",
+        help="HF tokenizer name/path, or 'byte' for the built-in offline byte tokenizer",
+    )
+    parser.add_argument("--sequence-length", type=int, default=4096)
+    parser.add_argument("--batch-size", type=int, default=1)
+    parser.add_argument(
+        "--fused-optimizer",
+        action="store_true",
+        help="Accepted for CLI parity; XLA always fuses the optimizer update on TPU",
+    )
+    parser.add_argument("--learning-rate", type=float, default=1e-5)
+    parser.add_argument("--lr-warmup-steps", type=int, default=10)
+    parser.add_argument("--training-steps", type=int, default=1000)
+    parser.add_argument("--logging-frequency", type=int, default=5,
+                        help="Log every --logging-frequency steps")
+    parser.add_argument("--grad-max-norm", type=float, default=1)
+    parser.add_argument("--model-dtype", type=str, default="bf16",
+                        help="Dtype for parameters, gradients and optimizer states")
+    parser.add_argument(
+        "--compile",
+        action="store_true",
+        help="Accepted for CLI parity; the train step is always jitted on TPU",
+    )
+    parser.add_argument("--raise-error", action="store_true",
+                        help="Raise an error in the training loop at --error-step")
+    parser.add_argument("--error-step", type=int, default=100,
+                        help="Step at which to raise an error if --raise-error is set")
+    # --- model selection ---
+    parser.add_argument("--model", type=str, default="gpt2-125m",
+                        help="Model preset: gpt2-125m | llama3-8b | tiny")
+    parser.add_argument("--vocab-size", type=int, default=0,
+                        help="0 = take vocab size from the tokenizer")
+    # --- TPU-native additions ---
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--dp", type=int, default=-1, help="data-parallel size (-1: infer)")
+    parser.add_argument("--fsdp", type=int, default=1, help="FSDP shard size")
+    parser.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    parser.add_argument("--sp", type=int, default=1, help="sequence-parallel (ring) size")
+    parser.add_argument("--attention-impl", type=str, default="auto",
+                        choices=["auto", "xla", "pallas", "ring"])
+    parser.add_argument("--remat", action="store_true",
+                        help="Rematerialize each transformer block (saves HBM)")
+    parser.add_argument("--master-weights", type=str, default="same",
+                        choices=["same", "fp32"])
+    parser.add_argument("--data-loading", type=str, default="map",
+                        choices=["map", "packed"])
+    parser.add_argument("--no-legacy-packing", dest="legacy_packing",
+                        action="store_false",
+                        help="Fix the reference packing quirks (buffer discard / doc re-read)")
+    parser.add_argument("--checkpoint-frequency", type=int, default=0,
+                        help="Save every N steps; 0 = fault-triggered only (reference behavior)")
+    parser.add_argument("--prefetch", type=int, default=2)
+    parser.add_argument("--inflight", type=int, default=2)
+    parser.add_argument("--profile-dir", type=str, default="")
+    parser.add_argument("--resubmit-command", type=str, default="",
+                        help="Override the self-resubmit command (tests); "
+                             "default: sbatch $WORKDIR/train.sh $SLURM_JOB_ID")
+    parser.add_argument("--distributed", action="store_true",
+                        help="jax.distributed.initialize() for multi-host pods")
+    args = parser.parse_args(argv)
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    return TrainConfig(**{k: v for k, v in vars(args).items() if k in fields})

@@ -1,0 +1,27 @@
+"""Precision registry (ref: utils.py:14-19).
+
+The reference constructs the model — and therefore the AdamW state — directly
+in the selected dtype via a ``torch.set_default_dtype`` context manager
+(ref: utils.py:100-110, train.py:54-55). JAX has no global default-dtype
+switch; instead the dtype is threaded explicitly as ``param_dtype`` (weights,
+and hence optimizer moments) and ``dtype`` (activations/compute) through the
+Flax modules, which is the idiomatic equivalent.
+"""
+
+import jax.numpy as jnp
+
+PRECISION_STR_TO_DTYPE = {
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "fp32": jnp.float32,
+    # fp64 requires `jax.config.update("jax_enable_x64", True)`; registered for
+    # CLI parity with the reference registry.
+    "fp64": jnp.float64,
+}
+
+DTYPE_TO_BYTES = {
+    jnp.float16: 2,
+    jnp.bfloat16: 2,
+    jnp.float32: 4,
+    jnp.float64: 8,
+}

@@ -1,0 +1,42 @@
+"""Throughput / MFU meters (reference has none — SURVEY.md §5.5 notes the gap;
+the only reference metric is the loss line at train.py:115-116)."""
+
+import time
+
+
+class Throughput:
+    """Steady-state tokens/sec and step-time tracker (excludes warmup steps)."""
+
+    def __init__(self, tokens_per_step: int, warmup_steps: int = 2):
+        self.tokens_per_step = tokens_per_step
+        self.warmup_steps = warmup_steps
+        self._seen = 0
+        self._t0 = None
+        self._steps = 0
+
+    def step(self) -> None:
+        self._seen += 1
+        if self._seen == self.warmup_steps:
+            self._t0 = time.perf_counter()
+        elif self._seen > self.warmup_steps:
+            self._steps += 1
+
+    @property
+    def steps_per_sec(self) -> float:
+        if not self._steps or self._t0 is None:
+            return 0.0
+        return self._steps / (time.perf_counter() - self._t0)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.steps_per_sec * self.tokens_per_step
+
+
+def transformer_flops_per_token(n_params: int, seq_len: int, dim: int,
+                                n_layers: int) -> float:
+    """~6N per token for fwd+bwd, plus attention score FLOPs (12*L*S*d per token)."""
+    return 6.0 * n_params + 12.0 * n_layers * dim * seq_len
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float, peak_flops: float) -> float:
+    return tokens_per_sec * flops_per_token / peak_flops

@@ -1,0 +1,24 @@
+"""LR schedules (ref: utils.py:32-56).
+
+The reference schedule is linear warmup followed by a constant multiplier of
+1.0 (despite its docstring claiming linear decay — ref: utils.py:37 vs 49-51).
+Under ``LambdaLR`` the factor at optimizer-update ``t`` (0-indexed) is
+``(t + 1) / (warmup_steps + 1)`` during warmup, then ``1.0``. We reproduce
+that exactly as an optax schedule: optax passes the 0-indexed update count.
+"""
+
+import jax.numpy as jnp
+
+
+def linear_warmup_constant(learning_rate: float, warmup_steps: int):
+    """Return an optax schedule matching the reference's LambdaLR semantics.
+
+    ref: utils.py:43-53 — ``(current_step + 1) / (warmup_steps + 1)`` during
+    warmup (0-indexed with the +1 adjustment), then a constant 1.0 factor.
+    """
+
+    def schedule(count):
+        factor = jnp.minimum((count + 1.0) / (warmup_steps + 1.0), 1.0)
+        return learning_rate * factor
+
+    return schedule

@@ -1,0 +1,54 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 build note)
+so DP/FSDP/TP/SP paths are testable with no TPU. Must run before jax imports.
+"""
+
+import os
+
+# The axon remote-TPU plugin (registered by sitecustomize when
+# PALLAS_AXON_POOL_IPS is set) dials the TPU tunnel from *every* python
+# process, even under JAX_PLATFORMS=cpu. Tests must be hermetic: run pytest
+# as `env -u PALLAS_AXON_POOL_IPS python -m pytest ...`; the pop below keeps
+# subprocesses spawned by tests clean either way.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# Numerics tests compare against fp64/fp32 oracles; JAX's *default* matmul
+# precision truncates to bf16-class even on CPU in this build.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    assert jax.device_count() >= 8
+    return jax.devices()[:8]
+
+
+@pytest.fixture()
+def tiny_parquet(tmp_path):
+    """Synthetic 'text'-column parquet file (the reference's data contract:
+    utils.py:118 'a parquet file containing a text column with documents')."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    docs = []
+    words = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+             "hotel", "india", "juliet"]
+    for i in range(64):
+        n = int(rng.integers(5, 120))
+        docs.append(" ".join(rng.choice(words, size=n).tolist()))
+    path = tmp_path / "train_data.parquet"
+    pq.write_table(pa.table({"text": docs}), path)
+    return str(path)

@@ -1,0 +1,177 @@
+"""End-to-end fault-tolerance tests driving the real CLI (train.py).
+
+These are the executable form of the reference's log-based verification
+(SURVEY.md §4): the three evidence chains — injected error, USR1 timeout
+with requeue, scancel — are asserted on the same audit strings the
+reference's README greps for, plus a bit-exactness upgrade: the resumed loss
+sequence must equal the uninterrupted run's exactly.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+CACHE = "/tmp/jax_test_compile_cache"
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["JAX_COMPILATION_CACHE_DIR"] = CACHE  # reuse compiles across runs
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    return env
+
+
+def _args(tmp_path, parquet, **over):
+    base = {
+        "--dataset": parquet,
+        "--checkpoint-path": str(tmp_path / "ckpts"),
+        "--tokenizer-name-or-path": "byte",
+        "--model": "tiny",
+        "--sequence-length": "128",
+        "--batch-size": "2",
+        "--training-steps": "30",
+        "--lr-warmup-steps": "5",
+        "--learning-rate": "1e-3",
+        "--logging-frequency": "1",
+    }
+    base.update({k: str(v) for k, v in over.items()})
+    argv = [sys.executable, str(REPO / "train.py")]
+    for k, v in base.items():
+        argv.append(k)
+        if v != "":
+            argv.append(v)
+    return argv
+
+
+def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None):
+    env = _env()
+    env["SLURM_JOB_ID"] = job_id
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+    if send_signal is not None:
+        # wait until training is underway (wait_for string seen), then signal
+        out_lines = []
+        deadline = time.time() + timeout
+        fired = False
+        for line in proc.stdout:
+            out_lines.append(line)
+            if not fired and wait_for in line:
+                proc.send_signal(send_signal)
+                fired = True
+            if time.time() > deadline:
+                proc.kill()
+                break
+        proc.wait(timeout=60)
+        return proc.returncode, "".join(out_lines)
+    out, _ = proc.communicate(timeout=timeout)
+    return proc.returncode, out
+
+
+def _losses(out):
+    return [line.split("Loss: ")[1].strip()
+            for line in out.splitlines() if "| Loss: " in line]
+
+
+@pytest.fixture(scope="module")
+def parquet(tmp_path_factory):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    words = ["alpha", "bravo", "charlie", "delta", "echo"]
+    docs = [" ".join(rng.choice(words, size=int(rng.integers(20, 120))))
+            for _ in range(128)]
+    path = tmp_path_factory.mktemp("data") / "train_data.parquet"
+    pq.write_table(pa.table({"text": docs}), path)
+    return str(path)
+
+
+def test_clean_run_completes(tmp_path, parquet):
+    rc, out = _run(_args(tmp_path, parquet), job_id="t0")
+    assert rc == 0, out
+    assert "Starting training!" in out
+    assert "Training completed" in out  # ref: train.py:118
+    assert len(_losses(out)) == 30
+
+
+def test_injected_error_saves_no_resubmit_then_bitexact_resume(tmp_path, parquet):
+    """The reference chain: --raise-error at N -> save, no requeue
+    (ref: utils.py:69-81), then a chained job resumes with an identical loss
+    trajectory (upgrade over the reference's visual log check)."""
+    rc, baseline = _run(_args(tmp_path / "base", parquet), job_id="b0")
+    assert rc == 0
+    base_losses = _losses(baseline)
+
+    argv = _args(tmp_path, parquet, **{"--raise-error": "",
+                                       "--error-step": "10"})
+    rc, out = _run(argv, job_id="j1")
+    assert rc == 0, out
+    assert "[EXIT HANDLER] Error during training encountered, saving checkpoint." in out
+    assert "Checkpoint saved at step" in out
+    assert "sbatch requeued" not in out  # error path never resubmits
+    ckpt_dir = tmp_path / "ckpts" / "checkpoint_j1"
+    assert ckpt_dir.exists()
+
+    rc, out2 = _run(_args(tmp_path, parquet, **{"--checkpoint-id": "j1"}),
+                    job_id="j2")
+    assert rc == 0, out2
+    assert "Resuming training from training_step" in out2  # ref: train.py:81
+    assert "Training completed" in out2
+    # Bit-exact continuity: every post-resume loss equals the uninterrupted
+    # run's loss at the same step.
+    resumed = {line.split("|")[0].split(":")[-1].strip(): line.split("Loss: ")[1].strip()
+               for line in out2.splitlines() if "| Loss: " in line}
+    for step_str, loss in resumed.items():
+        step = int(step_str)
+        assert base_losses[step] == loss, (step, base_losses[step], loss)
+
+
+def test_usr1_saves_and_resubmits(tmp_path, parquet):
+    """ref chain: USR1 -> save + sbatch requeue (utils.py:69-88)."""
+    marker = tmp_path / "resubmitted.txt"
+    argv = _args(tmp_path, parquet,
+                 **{"--training-steps": "100000",
+                    "--resubmit-command": f"touch {marker}"})
+    rc, out = _run(argv, job_id="u1", send_signal=signal.SIGUSR1,
+                   wait_for="Training step: 3")
+    assert rc == 0, out
+    assert "[EXIT HANDLER] Job timed out, saving checkpoint." in out
+    assert "Checkpoint saved at step" in out
+    assert "[EXIT HANDLER] sbatch requeued, new job will load the last checkpoint" in out
+    assert marker.exists()
+    assert (tmp_path / "ckpts" / "checkpoint_u1").exists()
+
+
+def test_sigterm_terminates_without_save(tmp_path, parquet):
+    """ref chain: scancel -> terminate, no checkpoint (utils.py:67-68)."""
+    argv = _args(tmp_path, parquet, **{"--training-steps": "100000"})
+    rc, out = _run(argv, job_id="c1", send_signal=signal.SIGTERM,
+                   wait_for="Training step: 3")
+    assert rc == 0, out
+    assert "[EXIT HANDLER] Job cancelled, terminating." in out
+    assert "saving checkpoint" not in out
+    assert not (tmp_path / "ckpts" / "checkpoint_c1" / "0").exists()
+
+
+def test_nonfinite_gradient_routes_to_error_path(tmp_path, parquet):
+    """A NaN/Inf grad norm must take the same -1 save path as the torch
+    error_if_nonfinite raise (ref: utils.py:61)."""
+    argv = _args(tmp_path, parquet, **{"--learning-rate": "1e18",
+                                       "--training-steps": "200"})
+    rc, out = _run(argv, job_id="n1")
+    assert rc == 0, out
+    # Either the loss diverges to a non-finite grad norm (expected with an
+    # absurd LR) and the error path saves, or the run completes — assert the
+    # first actually happened.
+    assert "non-finite gradient norm" in out
+    assert "[EXIT HANDLER] Error during training encountered, saving checkpoint." in out

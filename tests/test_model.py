@@ -1,0 +1,110 @@
+"""Model parity tests (ref: model.py:9-380)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+from fault_tolerant_llm_training_tpu.models.llama import RMSNorm
+from fault_tolerant_llm_training_tpu.ops.attention import xla_attention
+
+
+def test_ffn_hidden_rounding_matches_reference():
+    # ref: model.py:243-247 with the train.py:43-53 config -> 14336
+    assert get_config("llama3-8b").ffn_hidden_dim == 14336
+    # dataclass-default config: dim 4096, no multiplier, multiple_of 256
+    assert get_config("llama3-8b", ffn_dim_multiplier=None,
+                      multiple_of=256).ffn_hidden_dim == 11008
+
+
+def test_param_count_8b():
+    # SURVEY.md §2.1 #6: ≈8.05B at the reference trainer config.
+    cfg = get_config("llama3-8b")
+    assert abs(cfg.param_count() - 8.05e9) < 0.01e9
+
+
+def test_param_count_matches_eval_shape():
+    for preset in ("tiny", "gpt2-125m"):
+        cfg = get_config(preset)
+        m = Transformer(cfg)
+        shapes = jax.eval_shape(
+            m.init, jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+        n = sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
+        assert n == cfg.param_count(), preset
+
+
+def test_rmsnorm_fp32_internal():
+    # ref: model.py:43-48 — norm in fp32, cast back, then scale.
+    norm = RMSNorm(dim=8, eps=1e-5, param_dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4, 8)),
+                    jnp.float32)
+    params = norm.init(jax.random.PRNGKey(0), x)
+    out = norm.apply(params, x)
+    xf = np.asarray(x, np.float64)
+    want = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+def test_attention_is_causal():
+    # Perturbing future tokens must not change current logits.
+    cfg = get_config("tiny", attention_impl="xla", dtype=jnp.float32,
+                     param_dtype=jnp.float32)
+    m = Transformer(cfg)
+    t1 = jnp.asarray(np.random.default_rng(0).integers(0, 512, (1, 16)))
+    t2 = t1.at[:, 10:].set(7)
+    params = m.init(jax.random.PRNGKey(0), t1)["params"]
+    l1 = m.apply({"params": params}, t1)
+    l2 = m.apply({"params": params}, t2)
+    np.testing.assert_allclose(np.asarray(l1[:, :10]), np.asarray(l2[:, :10]),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(l1[:, 10:]), np.asarray(l2[:, 10:]))
+
+
+def test_gqa_grouped_einsum_matches_repeated_kv():
+    # The grouped einsum must equal the reference's repeat_kv expansion
+    # (ref: model.py:129-138,204-205) followed by plain MHA.
+    rng = np.random.default_rng(0)
+    b, s, h, kv, d = 2, 16, 8, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+    out = xla_attention(q, k, v, causal=True)
+    k_rep = jnp.repeat(k, h // kv, axis=2)
+    v_rep = jnp.repeat(v, h // kv, axis=2)
+    want = xla_attention(q, k_rep, v_rep, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_attention_matches_manual_softmax():
+    rng = np.random.default_rng(1)
+    b, s, h, d = 1, 8, 2, 4
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    got = np.asarray(xla_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=True))
+    # manual per-head causal softmax attention
+    want = np.zeros_like(got)
+    for hi in range(h):
+        scores = q[0, :, hi] @ k[0, :, hi].T / np.sqrt(d)
+        for i in range(s):
+            scores[i, i + 1:] = -np.inf
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want[0, :, hi] = p @ v[0, :, hi]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_remat_same_output():
+    cfg = get_config("tiny", attention_impl="xla", dtype=jnp.float32,
+                     param_dtype=jnp.float32)
+    t = jnp.asarray(np.random.default_rng(0).integers(0, 512, (1, 16)))
+    m = Transformer(cfg)
+    params = m.init(jax.random.PRNGKey(0), t)["params"]
+    m_remat = Transformer(cfg.replace(remat=True))
+    l1 = m.apply({"params": params}, t)
+    l2 = m_remat.apply({"params": params}, t)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)

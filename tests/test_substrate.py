@@ -1,0 +1,73 @@
+"""L1 substrate tests: flags, schedule, grad clip, dtype registry."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fault_tolerant_llm_training_tpu.utils import (
+    PRECISION_STR_TO_DTYPE,
+    get_args,
+    linear_warmup_constant,
+)
+from fault_tolerant_llm_training_tpu.utils.grad_clip import (
+    clip_grads_with_norm,
+    global_norm,
+)
+
+
+def test_reference_training_cmd_parses():
+    # The reference's shipped TRAINING_CMD (ref: train.sh:16-22) must parse.
+    cfg = get_args(
+        "--sequence-length 2048 --batch-size 1 --learning-rate 5e-5 "
+        "--lr-warmup-steps 100 --training-steps 1000 --raise-error "
+        "--error-step 600".split())
+    assert cfg.sequence_length == 2048
+    assert cfg.learning_rate == 5e-5
+    assert cfg.raise_error and cfg.error_step == 600
+    # chained resume plumbing (ref: train.sh:24-27)
+    cfg2 = get_args(["--checkpoint-id", "444664"])
+    assert cfg2.checkpoint_id == "444664"
+
+
+def test_flag_defaults_match_reference():
+    cfg = get_args([])
+    # ref: utils.py:114-201 defaults
+    assert cfg.sequence_length == 4096
+    assert cfg.batch_size == 1
+    assert cfg.learning_rate == 1e-5
+    assert cfg.lr_warmup_steps == 10
+    assert cfg.training_steps == 1000
+    assert cfg.logging_frequency == 5
+    assert cfg.grad_max_norm == 1
+    assert cfg.model_dtype == "bf16"
+    assert cfg.error_step == 100
+    assert not cfg.raise_error
+
+
+def test_schedule_matches_lambdalr_semantics():
+    # ref: utils.py:43-53 — factor (t+1)/(warmup+1) for t < warmup, else 1.
+    lr, warmup = 2.0, 10
+    sched = linear_warmup_constant(lr, warmup)
+    for t in range(25):
+        expected = lr * ((t + 1) / (warmup + 1) if t < warmup else 1.0)
+        assert np.isclose(float(sched(t)), expected), t
+
+
+def test_grad_clip_matches_torch_semantics():
+    grads = {"a": jnp.array([3.0, 4.0]), "b": jnp.array([[0.0]])}
+    norm = float(global_norm(grads))
+    assert np.isclose(norm, 5.0)
+    clipped, total = clip_grads_with_norm(grads, max_norm=1.0)
+    # torch coef: min(max_norm / (norm + 1e-6), 1) (ref: utils.py:62)
+    coef = 1.0 / (5.0 + 1e-6)
+    assert np.allclose(np.asarray(clipped["a"]), np.array([3.0, 4.0]) * coef)
+    # no clipping when under the norm
+    not_clipped, _ = clip_grads_with_norm(grads, max_norm=10.0)
+    assert np.allclose(np.asarray(not_clipped["a"]), np.array([3.0, 4.0]))
+
+
+def test_dtype_registry():
+    # ref: utils.py:14-19
+    assert PRECISION_STR_TO_DTYPE["bf16"] == jnp.bfloat16
+    assert PRECISION_STR_TO_DTYPE["fp32"] == jnp.float32
+    assert set(PRECISION_STR_TO_DTYPE) == {"fp16", "bf16", "fp32", "fp64"}

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Slurm batch driver (ref: train.sh:1-30), TPU edition.
+#
+# Contract kept from the reference:
+# - `sbatch train.sh [prev_jobid]` — optional positional arg becomes
+#   --checkpoint-id so the chained job resumes (ref: train.sh:24-27)
+# - `--signal=USR1@120` arms the pre-timeout warning (ref: train.sh:12)
+# - `--no-requeue`: the framework resubmits itself (ref: train.sh:14,
+#   utils.py:84)
+# - default TRAINING_CMD ships with fault injection ON so every run doubles
+#   as a failure-path test (ref: train.sh:21-22)
+#
+# TPU differences: one task per TPU host (srun spans the pod slice), no
+# container directive (the image is expected to carry JAX/libtpu), and the
+# headline config is the GPT-2-125M-class model from BASELINE.json.
+#SBATCH --job-name=ftllm_tpu
+#SBATCH --partition=normal
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=00:06:00
+#SBATCH --output=logs/output_%j.out
+#SBATCH --signal=USR1@120
+#SBATCH --no-requeue
+
+TRAINING_CMD=" --model gpt2-125m \
+               --sequence-length 2048 \
+               --batch-size 1 \
+               --learning-rate 5e-5 \
+               --lr-warmup-steps 100 \
+               --training-steps 1400 \
+               --raise-error \
+               --error-step 600"
+
+if [ -n "$1" ]; then
+    TRAINING_CMD="$TRAINING_CMD \
+     --checkpoint-id $1"
+fi
+export WORKDIR="${WORKDIR:-$(pwd)}"
+
+exec srun --unbuffered python "$WORKDIR/train.py" $TRAINING_CMD
