@@ -1,16 +1,17 @@
 """Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 build note)
-so DP/FSDP/TP/SP paths are testable with no TPU. Must run before jax imports.
+so DP/FSDP/TP/SP paths are testable with no TPU.
+
+A sitecustomize hook may import jax at interpreter startup (before conftest
+runs), so setting JAX_PLATFORMS via os.environ here is too late — the env
+value has already latched. XLA_FLAGS, however, is read at *backend init*
+(first device access), and ``jax.config.update`` can still retarget the
+platform as long as no backend has been initialized. Both are done below;
+subprocesses spawned by tests inherit the env vars and stay hermetic too.
 """
 
 import os
 
-# The axon remote-TPU plugin (registered by sitecustomize when
-# PALLAS_AXON_POOL_IPS is set) dials the TPU tunnel from *every* python
-# process, even under JAX_PLATFORMS=cpu. Tests must be hermetic: run pytest
-# as `env -u PALLAS_AXON_POOL_IPS python -m pytest ...`; the pop below keeps
-# subprocesses spawned by tests clean either way.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -21,6 +22,8 @@ import numpy as np
 import pytest
 
 import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 # Numerics tests compare against fp64/fp32 oracles; JAX's *default* matmul
 # precision truncates to bf16-class even on CPU in this build.
