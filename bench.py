@@ -35,6 +35,7 @@ def main():
         make_optimizer,
         make_train_step,
     )
+    from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
 
     on_tpu = jax.default_backend() != "cpu"
     seq = 2048
@@ -73,15 +74,19 @@ def main():
         labels = jnp.concatenate(
             [toks[:, 1:], jnp.full((batch, 1), -100, jnp.int32)], axis=1)
 
+        # hard_sync: block_until_ready alone does not wait for execution on
+        # the tunneled TPU backend (utils/sync.py), so timing anchors on a
+        # value fetch that depends on the whole donated-state chain.
         for _ in range(warmup):
             state, metrics = step_fn(state, toks, labels)
-        jax.block_until_ready(state)
+        hard_sync(metrics)
 
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = step_fn(state, toks, labels)
-        jax.block_until_ready(state)
+        hard_sync(metrics)
         dt = time.perf_counter() - t0
+        assert np.isfinite(float(metrics["loss"]))
 
     tokens_per_sec = batch * seq * steps / dt
     per_chip = tokens_per_sec / n_chips

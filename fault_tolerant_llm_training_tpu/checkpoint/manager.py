@@ -22,8 +22,9 @@ TPU-native design:
 import os
 from typing import Any, Optional, Tuple
 
-import jax
 import orbax.checkpoint as ocp
+
+from ..utils.sync import hard_sync
 
 
 class CheckpointManager:
@@ -42,7 +43,7 @@ class CheckpointManager:
              wait: bool = False) -> int:
         """Async sharded save of the TrainState + data-iterator position.
         ``wait=True`` blocks until the atomic commit (fault path)."""
-        jax.block_until_ready(state)
+        hard_sync(state)  # value-dependent barrier; see utils/sync.py
         self._mngr.save(
             step,
             args=ocp.args.Composite(

@@ -1,0 +1,29 @@
+"""Value-dependent device synchronization.
+
+``jax.block_until_ready`` tracks buffer *readiness events*, which on tunneled
+PJRT backends (the axon TPU client used here) can fire before the producing
+computation finishes, so it cannot be used to bound in-flight work or to
+delimit timing measurements there. Host *reads* of a value, by contrast, are
+data-dependent on every backend — a device->host transfer waits for the
+producing computation. (This also means correctness of downstream consumers
+that read values, e.g. Orbax checkpoint serialization, never depends on this
+barrier; it exists to drain dispatched work at a known point.)
+
+``hard_sync`` combines both: it materializes every scalar (0-d) leaf — all
+outputs of one XLA executable complete together, so for a tree produced by a
+single jitted step (TrainState with its ``step`` counter, a metrics dict)
+fetching one scalar output is an exact barrier for the whole tree — and then
+calls ``block_until_ready`` on the rest, which is exact on non-tunneled
+backends and covers leaves produced by other dispatches.
+"""
+
+import jax
+
+
+def hard_sync(tree) -> None:
+    """Drain the computation(s) producing ``tree`` (see module docstring)."""
+    scalars = [x for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "ndim") and x.ndim == 0]
+    if scalars:
+        jax.device_get(scalars)
+    jax.block_until_ready(tree)
