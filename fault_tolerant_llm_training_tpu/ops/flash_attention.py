@@ -1,19 +1,23 @@
-"""Pallas TPU flash attention (causal, GQA-aware).
+"""Pallas TPU flash attention (causal, GQA-aware), forward + backward.
 
 TPU-native replacement for the reference's fused-kernel dependency
 ``F.scaled_dot_product_attention(is_causal=True)`` (ref: model.py:212), which
 on CUDA comes from the NGC container. Here the kernel is first-party:
 an online-softmax tiled forward that never materializes the (S, S) score
 matrix — O(S) memory, q-tiles streamed through VMEM, scores computed on the
-MXU in fp32.
+MXU in fp32 — plus Pallas backward kernels (dq and dk/dv) that recompute
+scores per tile from the saved logsumexp, so the backward is O(S) memory too
+(the standard flash-attention-2 recomputation scheme).
 
-The backward pass currently recomputes attention through the XLA einsum path
-(same math, exact gradients, no saved probabilities); a Pallas backward kernel
-is the planned upgrade.
-
-GQA: the kernel maps query head ``h`` to KV head ``h // (H // K)`` in the
+GQA: the kernels map query head ``h`` to KV head ``h // (H // K)`` in the
 BlockSpec index map — KV are never repeated in memory (the reference's
-``repeat_kv`` at model.py:129-138 materializes the expansion).
+``repeat_kv`` at model.py:129-138 materializes the expansion). The dk/dv
+kernel runs one grid step per *KV* head and accumulates its query-head group
+in-kernel, so gradients are written at native KV-head granularity.
+
+lse/delta carry a trailing singleton dim — (B, H, S, 1) — because the Pallas
+TPU lowering requires a block's last two dims to be (8k, 128m)-tileable or
+full; (block_q, 1) satisfies that where rank-3 (1, 1, block_q) does not.
 """
 
 import functools
@@ -21,42 +25,52 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                causal: bool):
-    # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D)
+def _masked_scores(q, k, q_start, k_start, scale, causal):
+    """Scaled q @ k^T scores (fp32) with the causal mask applied.
+
+    Shared by the forward and both backward kernels so masking/scaling can
+    never desynchronize between them. q: (bq, D), k: (bk, D) -> (bq, bk).
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        bq, bk = s.shape
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _causal_k_blocks(q_start, block_q, s_k, block_k, causal):
+    """Number of k-blocks a q-tile starting at ``q_start`` attends to."""
+    if not causal:
+        return s_k // block_k
+    return jnp.minimum(
+        (q_start + block_q + block_k - 1) // block_k, s_k // block_k)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                scale: float, causal: bool):
+    # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D);
+    # lse_ref: (1, 1, block_q, 1)
     q = q_ref[0, 0]
     block_q, d = q.shape
     s_k = k_ref.shape[2]
-    qi = pl.program_id(2)
-    q_start = qi * block_q
-
-    if causal:
-        # Only k-blocks whose start is <= the last query position matter.
-        num_k_blocks = jnp.minimum(
-            (q_start + block_q + block_k - 1) // block_k, s_k // block_k)
-    else:
-        num_k_blocks = s_k // block_k
+    q_start = pl.program_id(2) * block_q
+    num_k_blocks = _causal_k_blocks(q_start, block_q, s_k, block_k, causal)
 
     def body(j, carry):
         m_prev, l_prev, acc_prev = carry
         k_start = j * block_k
         k = k_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _masked_scores(q, k, q_start, k_start, scale, causal)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_prev - m_new)
@@ -72,6 +86,90 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
             jnp.zeros((block_q, d), jnp.float32))
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, init)
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, scale: float, causal: bool):
+    # q/do/dq: (1, 1, block_q, D); k/v: (1, 1, S, D);
+    # lse/delta: (1, 1, block_q, 1)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    block_q, d = q.shape
+    s_k = k_ref.shape[2]
+    q_start = pl.program_id(2) * block_q
+    num_k_blocks = _causal_k_blocks(q_start, block_q, s_k, block_k, causal)
+
+    def body(j, dq_acc):
+        k_start = j * block_k
+        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+        v = v_ref[0, 0, pl.ds(k_start, block_k), :]
+        s = _masked_scores(q, k, q_start, k_start, scale, causal)
+        p = jnp.exp(s - lse)  # exact probabilities; lse is (block_q, 1)
+        dp = jax.lax.dot_general(  # dO @ V^T: (block_q, block_k)
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, scale: float, causal: bool):
+    # Grid step = one KV head. k/v/dk/dv: (1, 1, block_k, D);
+    # q/do: (1, G, S, D) — this KV head's G query heads; lse/delta: (1, G, S, 1)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    block_k, d = k.shape
+    group = q_ref.shape[1]
+    s_q = q_ref.shape[2]
+    k_start = pl.program_id(2) * block_k
+    n_q_blocks = s_q // block_q
+    # Causal: q blocks strictly before this k block contribute nothing.
+    j_start = k_start // block_q if causal else 0
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        q_start = j * block_q
+        for g in range(group):  # static loop: accumulate the GQA group
+            q = q_ref[0, g, pl.ds(q_start, block_q), :]
+            do = do_ref[0, g, pl.ds(q_start, block_q), :]
+            lse = lse_ref[0, g, pl.ds(q_start, block_q), :]
+            delta = delta_ref[0, g, pl.ds(q_start, block_q), :]
+            s = _masked_scores(q, k, q_start, k_start, scale, causal)
+            p = jnp.exp(s - lse)  # lse is (block_q, 1)
+            dv_acc = dv_acc + jax.lax.dot_general(  # P^T @ dO
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(  # dO @ V^T
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale
+            dk_acc = dk_acc + jax.lax.dot_general(  # dS^T @ Q
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(j_start, n_q_blocks, body, init)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _blocks(s, block_q, block_k):
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (
+        f"seq len {s} must be divisible by block sizes ({block_q}, {block_k})")
+    return block_q, block_k
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -82,15 +180,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     b, h, s, d = qt.shape
     kv_heads = kt.shape[1]
     group = h // kv_heads
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0, (
-        f"seq len {s} must be divisible by block sizes ({block_q}, {block_k})")
+    block_q, block_k = _blocks(s, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
 
     kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
                                causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, s // block_q),
         in_specs=[
@@ -98,32 +193,100 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    """Pallas backward: dq via (head, q-tile) grid, dk/dv via a
+    (kv-head, k-tile) grid that accumulates the GQA group in-kernel."""
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    ot = jnp.transpose(o, (0, 2, 1, 3))
+    dot = jnp.transpose(g, (0, 2, 1, 3))
+    b, h, s, d = qt.shape
+    kv_heads = kt.shape[1]
+    group = h // kv_heads
+    block_q, block_k = _blocks(s, block_q, block_k)
+    scale = 1.0 / (d ** 0.5)
+    # delta_i = sum_d dO_i . O_i  (rowwise), the softmax-normalization term.
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda bi, hi, qi: (bi, hi, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal),
+        grid=(b, h, s // block_q),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    )(qt, kt, vt, dot, lse, delta)
+
+    # Grid over KV heads: block index maps pick up this head's group of G
+    # query heads ((1, G, ...) blocks); dk/dv land at KV-head granularity —
+    # no (B, H, S, D) expansion buffer.
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0))
+    qgrp_spec = pl.BlockSpec((1, group, s, d), lambda bi, hi, ki: (bi, hi, 0, 0))
+    rowgrp_spec = pl.BlockSpec((1, group, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
+                          causal=causal),
+        grid=(b, kv_heads, s // block_k),
+        in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
+                  rowgrp_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    dq_out = jnp.transpose(dq, (0, 2, 1, 3))
+    dk_out = jnp.transpose(dk, (0, 2, 1, 3))
+    dv_out = jnp.transpose(dv, (0, 2, 1, 3))
+    return dq_out, dk_out, dv_out
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal=True):
     """Causal flash attention; q (B,S,H,D), k/v (B,S,K,D) -> (B,S,H,D)."""
-    interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-                      interpret)
+    out, _ = _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                        _interpret())
+    return out
 
 
 def _flash_attention_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                          _interpret())
+    return out, (q, k, v, out, lse)
 
 
 def _flash_attention_bwd(causal, residuals, g):
-    from .attention import xla_attention
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: xla_attention(q_, k_, v_, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_bwd(q, k, v, o, lse, g, causal, DEFAULT_BLOCK_Q,
+                      DEFAULT_BLOCK_K, _interpret())
 
 
 flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

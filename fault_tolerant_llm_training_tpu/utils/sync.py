@@ -22,8 +22,14 @@ import jax
 
 def hard_sync(tree) -> None:
     """Drain the computation(s) producing ``tree`` (see module docstring)."""
-    scalars = [x for x in jax.tree_util.tree_leaves(tree)
-               if hasattr(x, "ndim") and x.ndim == 0]
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "ndim")]
+    scalars = [x for x in leaves if x.ndim == 0]
     if scalars:
         jax.device_get(scalars)
+    elif leaves:
+        # No scalar outputs: fetch one element of every leaf (leaves may come
+        # from different dispatches) — still a value-dependent barrier,
+        # unlike block_until_ready alone; one batched transfer.
+        jax.device_get([x[(0,) * x.ndim] for x in leaves])
     jax.block_until_ready(tree)

@@ -21,11 +21,15 @@ def test_flash_matches_reference(s, h, kv, d):
                                rtol=2e-4, atol=2e-5)
 
 
-def test_flash_gradients_match():
+@pytest.mark.parametrize("s,h,kv,d", [
+    (256, 2, 2, 32),   # single q/k block
+    (512, 4, 2, 32),   # GQA group-sum + multi-block causal bounds
+])
+def test_flash_gradients_match(s, h, kv, d):
     rng = np.random.default_rng(1)
-    q = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((1, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
 
     g_ref = jax.grad(lambda *a: jnp.sum(xla_attention(*a, causal=True) ** 2),
                      argnums=(0, 1, 2))(q, k, v)
