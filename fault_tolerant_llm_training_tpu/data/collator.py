@@ -11,6 +11,8 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from .native import collate_clm
+
 
 @dataclasses.dataclass
 class CollatorForCLM:
@@ -20,9 +22,7 @@ class CollatorForCLM:
     def __call__(self, examples: List[Dict]) -> Tuple[np.ndarray, np.ndarray]:
         input_ids = np.asarray([e["input_ids"] for e in examples],
                                dtype=np.int32)  # (B, S+1)
-        inputs = input_ids[:, :-1].copy()
-        labels = input_ids[:, 1:].copy()
-        labels[labels == self.pad_token_id] = -100
+        inputs, labels = collate_clm(input_ids, self.pad_token_id)
         assert inputs.shape[1] == labels.shape[1] == self.sequence_length
         assert inputs.shape == labels.shape
         return inputs, labels

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native host-loader (native/hostloader.cpp).
+
+The shared library is compiled lazily on first use with the system g++ (no
+build step, no pybind11 dependency) and cached, keyed by a hash of the .cpp
+source *and* the host CPU (the build uses ``-march=native``, so a cache dir
+on shared storage must not serve another machine's code). Every binding has
+a numpy fallback with identical semantics — ``have_native()`` reports which
+path is active, and ``FTL_DISABLE_NATIVE=1`` forces the fallback (used by
+the parity tests and as an escape hatch).
+"""
+
+import ctypes
+import hashlib
+import logging
+import os
+import platform
+import subprocess
+import tempfile
+
+import numpy as np
+
+logger = logging.getLogger()
+
+_SRC = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                    "native", "hostloader.cpp")
+_LIB = None
+_TRIED = False
+
+
+def _host_key() -> str:
+    """Discriminates -march=native artifacts between host CPU types."""
+    parts = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags")):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:8]
+
+
+def _build_and_load():
+    src = os.path.abspath(_SRC)
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "FTL_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "ftl_native"))
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir,
+                           f"hostloader_{digest}_{_host_key()}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             "-o", tmp, src],
+            check=True, capture_output=True)
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(so_path)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ftl_collate_clm.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_int32, i32p, i32p]
+    lib.ftl_collate_clm.restype = None
+    lib.ftl_pack_clm.argtypes = [i32p, ctypes.c_int64, ctypes.c_int32,
+                                 i32p, i32p]
+    lib.ftl_pack_clm.restype = None
+    lib.ftl_byte_tokenize.argtypes = [u8p, ctypes.c_int64, ctypes.c_int32,
+                                      ctypes.c_int32, i32p]
+    lib.ftl_byte_tokenize.restype = ctypes.c_int64
+    return lib
+
+
+def _lib():
+    """Build/load on first call; None when disabled or the build failed."""
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        if os.environ.get("FTL_DISABLE_NATIVE") != "1":
+            try:
+                _LIB = _build_and_load()
+            except Exception as e:  # no g++, read-only fs, ...
+                logger.warning("native hostloader unavailable (%s: %s); "
+                               "using numpy fallback", type(e).__name__, e)
+    return _LIB
+
+
+def have_native() -> bool:
+    return _lib() is not None
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def collate_clm(batch: np.ndarray, pad_id: int):
+    """(B, S+1) int32 ids -> (inputs, labels), labels pad-masked to -100
+    (ref: dataset.py:44-53)."""
+    batch = np.ascontiguousarray(batch, dtype=np.int32)
+    b, seq_plus1 = batch.shape
+    s = seq_plus1 - 1
+    inputs = np.empty((b, s), np.int32)
+    labels = np.empty((b, s), np.int32)
+    lib = _lib()
+    if lib is not None:
+        lib.ftl_collate_clm(_i32(batch), b, seq_plus1, pad_id,
+                            _i32(inputs), _i32(labels))
+    else:
+        inputs[:] = batch[:, :-1]
+        labels[:] = batch[:, 1:]
+        labels[labels == pad_id] = -100
+    return inputs, labels
+
+
+def pack_clm(chunk: np.ndarray, bos_id: int):
+    """(S+1,) packed int32 ids -> (inputs, labels), BOS positions masked
+    to -100 on both sides (ref: dataset.py:96-100)."""
+    chunk = np.ascontiguousarray(chunk, dtype=np.int32)
+    s = chunk.shape[0] - 1
+    inputs = np.empty((s,), np.int32)
+    labels = np.empty((s,), np.int32)
+    lib = _lib()
+    if lib is not None:
+        lib.ftl_pack_clm(_i32(chunk), s + 1, bos_id, _i32(inputs),
+                         _i32(labels))
+    else:
+        inputs[:] = chunk[:-1]
+        labels[:] = chunk[1:]
+        labels[inputs == bos_id] = -100
+        labels[labels == bos_id] = -100
+    return inputs, labels
+
+
+def byte_tokenize(text: str, bos_id: int, offset: int) -> np.ndarray:
+    """UTF-8 bytes + ``offset`` with optional BOS prefix (bos_id < 0 omits)."""
+    data = text.encode("utf-8")
+    n = len(data)
+    out = np.empty((n + (1 if bos_id >= 0 else 0),), np.int32)
+    lib = _lib()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * n).from_buffer_copy(data) if n else \
+            (ctypes.c_uint8 * 1)()
+        lib.ftl_byte_tokenize(buf, n, bos_id, offset, _i32(out))
+    else:
+        w = 0
+        if bos_id >= 0:
+            out[0] = bos_id
+            w = 1
+        out[w:] = np.frombuffer(data, np.uint8).astype(np.int32) + offset
+    return out

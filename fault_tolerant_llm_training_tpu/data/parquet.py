@@ -26,6 +26,8 @@ from typing import Dict, Tuple
 import numpy as np
 import pyarrow.parquet as pq
 
+from .native import pack_clm
+
 
 class _ParquetText:
     """Memory-mapped 'text' column access (ref: dataset.py:18,28)."""
@@ -136,10 +138,7 @@ class IterableParquetDataset:
             chunk, self.token_buffer = (self.token_buffer[:need],
                                         self.token_buffer[need:])
         arr = np.asarray(chunk, dtype=np.int32)
-        inputs, labels = arr[:-1].copy(), arr[1:].copy()
-        labels[inputs == self.bos_token_id] = -100
-        labels[labels == self.bos_token_id] = -100
-        return inputs, labels
+        return pack_clm(arr, self.bos_token_id)
 
     def get_state(self) -> Dict:
         return {

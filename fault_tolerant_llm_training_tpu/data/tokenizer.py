@@ -16,7 +16,11 @@ returning ``{"input_ids": [...]}`` (ref: dataset.py:29-35,84-89), plus
 """
 
 import logging
-from typing import Dict, List
+from typing import Dict
+
+import numpy as np
+
+from .native import byte_tokenize
 
 logger = logging.getLogger()
 
@@ -33,19 +37,22 @@ class ByteTokenizer:
     def vocab_size(self) -> int:
         return 256 + self._OFFSET
 
-    def encode(self, text: str, add_bos: bool = True) -> List[int]:
-        ids = [b + self._OFFSET for b in text.encode("utf-8")]
-        return ([self.bos_token_id] + ids) if add_bos else ids
+    def encode(self, text: str, add_bos: bool = True) -> np.ndarray:
+        return byte_tokenize(text, self.bos_token_id if add_bos else -1,
+                             self._OFFSET)
 
     def encode_plus(self, text: str, max_length: int = None, padding=False,
                     truncation: bool = False, padding_side: str = "right"
-                    ) -> Dict[str, List[int]]:
+                    ) -> Dict[str, np.ndarray]:
         ids = self.encode(text)
         if truncation and max_length is not None:
             ids = ids[:max_length]
-        if padding == "max_length" and max_length is not None:
-            pad = [self.pad_token_id] * (max_length - len(ids))
-            ids = (ids + pad) if padding_side == "right" else (pad + ids)
+        if (padding == "max_length" and max_length is not None
+                and len(ids) < max_length):
+            pad = np.full((max_length - len(ids),), self.pad_token_id,
+                          np.int32)
+            ids = (np.concatenate([ids, pad]) if padding_side == "right"
+                   else np.concatenate([pad, ids]))
         return {"input_ids": ids}
 
     def decode(self, ids) -> str:

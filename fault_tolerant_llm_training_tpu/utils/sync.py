@@ -23,7 +23,7 @@ import jax
 def hard_sync(tree) -> None:
     """Drain the computation(s) producing ``tree`` (see module docstring)."""
     leaves = [x for x in jax.tree_util.tree_leaves(tree)
-              if hasattr(x, "ndim")]
+              if hasattr(x, "ndim") and getattr(x, "size", 0) > 0]
     scalars = [x for x in leaves if x.ndim == 0]
     if scalars:
         jax.device_get(scalars)

@@ -1,0 +1,61 @@
+// Native host-side data-pipeline hot path.
+//
+// The reference framework's data layer is pure Python (ref: dataset.py) and
+// leans on the PyTorch container for native speed; at TPU step rates the
+// host-side batch assembly (tokenize -> pack -> shift -> mask) becomes the
+// bottleneck (SURVEY.md §7.3 #5). These kernels do the per-batch O(B*S) work
+// in C++ behind ctypes bindings (data/native.py); each has a numpy fallback
+// with identical semantics.
+//
+// All functions are C ABI, operate on caller-allocated buffers, and are
+// thread-safe (no global state) so the Python prefetch thread can call them
+// without holding locks.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// CLM collation (ref: dataset.py:44-53): batch (B, S+1) token ids ->
+// inputs = [:, :-1], labels = [:, 1:] with pad positions masked to -100.
+void ftl_collate_clm(const int32_t* batch, int64_t b, int64_t seq_plus1,
+                     int32_t pad_id, int32_t* inputs, int32_t* labels) {
+  const int64_t s = seq_plus1 - 1;
+  for (int64_t i = 0; i < b; ++i) {
+    const int32_t* row = batch + i * seq_plus1;
+    int32_t* in_row = inputs + i * s;
+    int32_t* lb_row = labels + i * s;
+    std::memcpy(in_row, row, s * sizeof(int32_t));
+    for (int64_t j = 0; j < s; ++j) {
+      const int32_t t = row[j + 1];
+      lb_row[j] = (t == pad_id) ? -100 : t;
+    }
+  }
+}
+
+// Packed-CLM sample assembly (ref: dataset.py:96-100): a chunk of seq_len+1
+// packed tokens -> shifted inputs/labels with BOS positions masked to -100
+// on both sides (where input == BOS or label == BOS).
+void ftl_pack_clm(const int32_t* chunk, int64_t seq_plus1, int32_t bos_id,
+                  int32_t* inputs, int32_t* labels) {
+  const int64_t s = seq_plus1 - 1;
+  for (int64_t j = 0; j < s; ++j) {
+    const int32_t in = chunk[j];
+    const int32_t lb = chunk[j + 1];
+    inputs[j] = in;
+    labels[j] = (in == bos_id || lb == bos_id) ? -100 : lb;
+  }
+}
+
+// Byte-level tokenization (data/tokenizer.py ByteTokenizer): UTF-8 bytes
+// shifted by `offset`, optionally prefixed with BOS. Returns the number of
+// ids written (n + (bos_id >= 0)).
+int64_t ftl_byte_tokenize(const uint8_t* text, int64_t n, int32_t bos_id,
+                          int32_t offset, int32_t* out) {
+  int64_t w = 0;
+  if (bos_id >= 0) out[w++] = bos_id;
+  for (int64_t i = 0; i < n; ++i) out[w++] = offset + (int32_t)text[i];
+  return w;
+}
+
+}  // extern "C"
