@@ -5,8 +5,9 @@ build step, no pybind11 dependency) and cached, keyed by a hash of the .cpp
 source *and* the host CPU (the build uses ``-march=native``, so a cache dir
 on shared storage must not serve another machine's code). Every binding has
 a numpy fallback with identical semantics — ``have_native()`` reports which
-path is active, and ``FTL_DISABLE_NATIVE=1`` forces the fallback (used by
-the parity tests and as an escape hatch).
+path is active, and ``FTL_DISABLE_NATIVE=1`` forces the fallback as an
+escape hatch (the parity tests instead monkeypatch ``_LIB`` so both branches
+run in one process).
 """
 
 import ctypes
@@ -16,6 +17,8 @@ import os
 import platform
 import subprocess
 import tempfile
+import threading
+import uuid
 
 import numpy as np
 
@@ -25,6 +28,7 @@ _SRC = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                     "native", "hostloader.cpp")
 _LIB = None
 _TRIED = False
+_LOCK = threading.Lock()
 
 
 def _host_key() -> str:
@@ -32,9 +36,13 @@ def _host_key() -> str:
     parts = [platform.machine()]
     try:
         with open("/proc/cpuinfo") as f:
+            found = set()
             for line in f:
-                if line.startswith(("model name", "flags")):
+                key = line.split(":", 1)[0].strip()
+                if key in ("model name", "flags") and key not in found:
+                    found.add(key)
                     parts.append(line.strip())
+                if len(found) == 2:
                     break
     except OSError:
         pass
@@ -52,12 +60,14 @@ def _build_and_load():
     so_path = os.path.join(cache_dir,
                            f"hostloader_{digest}_{_host_key()}.so")
     if not os.path.exists(so_path):
-        tmp = so_path + f".tmp{os.getpid()}"
+        # unique per builder (pid AND thread/uuid): concurrent builders each
+        # write their own temp file, and the os.replace install is atomic.
+        tmp = so_path + f".tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC",
              "-o", tmp, src],
             check=True, capture_output=True)
-        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+        os.replace(tmp, so_path)
     lib = ctypes.CDLL(so_path)
     i32p = ctypes.POINTER(ctypes.c_int32)
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -74,16 +84,20 @@ def _build_and_load():
 
 
 def _lib():
-    """Build/load on first call; None when disabled or the build failed."""
+    """Build/load on first call; None when disabled or the build failed.
+    Thread-safe: the prefetch thread and main thread may race here."""
     global _LIB, _TRIED
     if not _TRIED:
-        _TRIED = True
-        if os.environ.get("FTL_DISABLE_NATIVE") != "1":
-            try:
-                _LIB = _build_and_load()
-            except Exception as e:  # no g++, read-only fs, ...
-                logger.warning("native hostloader unavailable (%s: %s); "
-                               "using numpy fallback", type(e).__name__, e)
+        with _LOCK:
+            if not _TRIED:
+                if os.environ.get("FTL_DISABLE_NATIVE") != "1":
+                    try:
+                        _LIB = _build_and_load()
+                    except Exception as e:  # no g++, read-only fs, ...
+                        logger.warning(
+                            "native hostloader unavailable (%s: %s); "
+                            "using numpy fallback", type(e).__name__, e)
+                _TRIED = True
     return _LIB
 
 

@@ -50,7 +50,12 @@ def classify_exception(e: BaseException) -> int:
 
 def resubmit(logger, command: str = "") -> bool:
     """Chain the next job: ``sbatch $WORKDIR/train.sh $SLURM_JOB_ID``
-    (ref: utils.py:83-88). Returns True on queue success."""
+    (ref: utils.py:83-88). Returns True on queue success. On a pod, only
+    process 0 submits — N hosts must not queue N duplicate jobs."""
+    from .multihost import should_resubmit
+
+    if not should_resubmit():
+        return True
     cmd = command or f"sbatch {WORKDIR}/train.sh {JOBID}"
     ret = os.system(cmd)
     if ret != 0:

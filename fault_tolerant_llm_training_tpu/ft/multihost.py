@@ -1,0 +1,76 @@
+"""Multi-host fault-tolerance coordination (SURVEY.md §5.3 TPU equivalent).
+
+The reference is single-process (SURVEY.md §2.3) — its signal handler and
+checkpoint writer never have to agree with anyone. On a TPU pod every host
+process receives Slurm's SIGUSR1/SIGTERM independently and at slightly
+different times, and a host that unilaterally stops stepping deadlocks the
+others inside the next XLA collective. The protocol here:
+
+1. every host records signals locally (ft/signals.py flag pattern);
+2. at each check boundary the hosts *agree* on one verdict via a tiny
+   process allgather (``agree_on_signal``) — so either every host raises
+   ``TrainingSignal`` at the same step, or none does;
+3. the coordinated Orbax save runs on all hosts (sharded per-host writes,
+   Orbax's own barrier commits atomically);
+4. only process 0 resubmits the Slurm chain (``should_resubmit``) — the
+   reference's single ``sbatch`` call (ref: utils.py:84) must not become
+   N duplicate jobs.
+
+Signal-combination policy: USR1 (timeout pre-warning, save + requeue) wins
+over TERM (cancel, no save) when hosts disagree mid-grace-period — the
+Slurm timeout chain delivers USR1 first, so a mixed view means a preemption
+is in progress and losing the checkpoint would be the worse failure.
+"""
+
+import signal
+from typing import Iterable, Optional
+
+import jax
+
+_USR1 = int(signal.SIGUSR1)  # 10: save + requeue
+_TERM = int(signal.SIGTERM)  # 15: no save
+
+
+def combine_signals(signums: Iterable[int]) -> Optional[int]:
+    """One cluster-wide verdict from per-host signal numbers (0/None = none)."""
+    seen = {int(s) for s in signums if s}
+    if not seen:
+        return None
+    if _USR1 in seen:
+        return _USR1
+    if _TERM in seen:
+        return _TERM
+    return min(seen)  # deterministic pick for exotic codes
+
+
+def agree_on_signal(local_signum: Optional[int]) -> Optional[int]:
+    """Allgather each host's pending signal and apply ``combine_signals``.
+
+    Single-process (the reference's regime and all CPU tests): identity.
+    """
+    if jax.process_count() == 1:
+        return local_signum
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        jnp.int32(local_signum or 0))
+    return combine_signals(int(x) for x in gathered.flatten())
+
+
+def barrier(name: str) -> None:
+    """Block until every host reaches this point (pre-save drain)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def should_resubmit() -> bool:
+    """Exactly one host chains the next Slurm job (ref: utils.py:84)."""
+    return is_coordinator()

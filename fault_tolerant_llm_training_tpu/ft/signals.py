@@ -60,9 +60,21 @@ class SignalFlag:
         signal.signal(signal.SIGUSR1, self._handler)
         signal.signal(signal.SIGTERM, self._handler)
 
-    def check(self) -> None:
-        if self.signum is not None:
-            signum, self.signum = self.signum, None
+    def check(self, synced: bool = False) -> None:
+        """Raise ``TrainingSignal`` if a fault signal is pending.
+
+        ``synced=True`` first agrees on a cluster-wide verdict with the other
+        hosts (ft/multihost.py): either every host raises at this boundary or
+        none does — a host raising alone would deadlock the rest inside the
+        next XLA collective. Single-process: identical to ``synced=False``.
+        """
+        signum = self.signum
+        if synced:
+            from .multihost import agree_on_signal
+
+            signum = agree_on_signal(signum)
+        if signum is not None:
+            self.signum = None
             raise TrainingSignal(signum)
 
     @contextlib.contextmanager

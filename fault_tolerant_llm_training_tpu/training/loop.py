@@ -33,6 +33,7 @@ from ..data.loader import DataLoader
 from ..data.parquet import IterableParquetDataset, ParquetDataset
 from ..data.prefetch import DevicePrefetcher
 from ..data.tokenizer import load_tokenizer
+from ..ft.multihost import barrier
 from ..ft.signals import SignalFlag
 from ..models import Transformer, get_config
 from ..parallel.mesh import make_mesh, use_mesh
@@ -71,6 +72,9 @@ class Trainer:
 
         if cfg.distributed:
             jax.distributed.initialize()
+        # Multihost: every signal check must be a cluster-wide agreement
+        # (ft/multihost.py) so all hosts raise at the same boundary.
+        self._sync_signals = jax.process_count() > 1
 
         self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp)
         self._mesh_ctx = use_mesh(self.mesh)
@@ -82,7 +86,7 @@ class Trainer:
         if cfg.checkpoint_id:
             logger.info(f"Loading checkpoint from {cfg.checkpoint_path}")
             read_mngr = CheckpointManager(cfg.checkpoint_path, cfg.checkpoint_id)
-        self.signal_flag.check()
+        self.signal_flag.check(synced=self._sync_signals)
 
         # --- data (ref: train.py:27-34) ---
         logger.info("Setting up DataLoaders...")
@@ -100,7 +104,7 @@ class Trainer:
                 bos_token_id=self.tokenizer.bos_token_id,
                 legacy=cfg.legacy_packing)
             self.loader = DataLoader(dataset, cfg.batch_size)
-        self.signal_flag.check()
+        self.signal_flag.check(synced=self._sync_signals)
 
         # --- model + optimizer (ref: train.py:42-77) ---
         logger.info("Setting up Model...")
@@ -147,7 +151,7 @@ class Trainer:
                                  out_shardings=self.state_shardings)(
                 jax.random.PRNGKey(cfg.seed))
             self._last_data_state = self.loader.get_state()
-        self.signal_flag.check()
+        self.signal_flag.check(synced=self._sync_signals)
 
         # Save manager for *this* job's id (ref naming: checkpoint_{JOBID},
         # utils.py:80) — files accumulate one dir per preemption, like the
@@ -197,8 +201,18 @@ class Trainer:
         cfg = self.cfg
         inflight = collections.deque()
         it = iter(self.prefetcher)
+        sync_freq = max(1, cfg.signal_sync_frequency)
         while self.training_step < cfg.training_steps:
-            self.signal_flag.check()
+            if self._sync_signals:
+                # Cluster-wide agreement only at sync boundaries: the
+                # allgather is a blocking collective that drains the
+                # dispatch pipeline (see TrainConfig.signal_sync_frequency).
+                # Off-boundary local raises are skipped — a host raising
+                # alone would deadlock the others in the next collective.
+                if self.training_step % sync_freq == 0:
+                    self.signal_flag.check(synced=True)
+            else:
+                self.signal_flag.check()
             inputs, labels, data_state = next(it)
             self.state, metrics = self._compiled_step(self.state, inputs,
                                                       labels)
@@ -249,6 +263,7 @@ class Trainer:
         @427 — BASELINE.md)."""
         if stop_prefetch:
             self.prefetcher.stop()
+        barrier("ftl:pre-save")  # all hosts drained to the same step
         step = int(jax.device_get(self.state.step))
         data_state = self._last_data_state or self.loader.get_state()
         self.ckpt_mngr.save(step, self.state, data_state, wait=wait)

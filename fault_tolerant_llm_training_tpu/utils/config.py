@@ -57,6 +57,11 @@ class TrainConfig:
     checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
     prefetch: int = 2  # host->device prefetch depth (reference has none)
     inflight: int = 2  # max dispatched-but-unfinished steps (bounds signal latency)
+    # Multihost: steps between cluster-wide signal agreements. The agreement
+    # is a blocking device allgather that drains the dispatch pipeline, so
+    # running it every step would force inflight=1 on a pod; every N steps
+    # bounds signal latency to N*step_time (vs the 120 s USR1 lead).
+    signal_sync_frequency: int = 5
     profile_dir: str = ""  # jax.profiler trace output; "" = off
     resubmit_command: str = ""  # override for tests; default: sbatch $WORKDIR/train.sh
     distributed: bool = False  # call jax.distributed.initialize() (multi-host pods)
@@ -140,6 +145,7 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Save every N steps; 0 = fault-triggered only (reference behavior)")
     parser.add_argument("--prefetch", type=int, default=2)
     parser.add_argument("--inflight", type=int, default=2)
+    parser.add_argument("--signal-sync-frequency", type=int, default=5)
     parser.add_argument("--profile-dir", type=str, default="")
     parser.add_argument("--resubmit-command", type=str, default="",
                         help="Override the self-resubmit command (tests); "
