@@ -25,6 +25,8 @@ Differences from the reference (all safety upgrades, SURVEY.md §5.3):
 
 import os
 
+import jax
+
 from ..utils.config import JOBID, WORKDIR
 from ..utils.logging import (
     AUDIT_CANCELLED,
@@ -78,8 +80,21 @@ def handle_exit(trainer, error_type: int, logger) -> None:
             logger.info(AUDIT_ERROR_SAVING)
         saved_step = None
         if trainer is not None and getattr(trainer, "state", None) is not None:
-            saved_step = trainer.save_checkpoint(wait=True)
-            logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
+            # Coordination: signal exits were agreed cluster-wide
+            # (ft/signals.py synced check), and deterministic code errors
+            # (injection, non-finite grads) hit every host at the same step.
+            # An error of unknown provenance may be host-local: on a pod the
+            # other hosts are still stepping, so a coordinated (barrier +
+            # collective Orbax write) save would hang — skip it there.
+            coordinated = (error_type == SIGNAL_TIMEOUT
+                           or getattr(trainer, "error_is_replicated", False))
+            if coordinated or jax.process_count() == 1:
+                saved_step = trainer.save_checkpoint(wait=True,
+                                                     coordinated=coordinated)
+                logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
+            else:
+                logger.info("[EXIT HANDLER] Host-local error on a multi-host "
+                            "run: cannot write a coordinated checkpoint.")
         else:
             logger.info("[EXIT HANDLER] No training state to save yet.")
         if error_type == SIGNAL_TIMEOUT:

@@ -59,6 +59,10 @@ class Trainer:
         self.training_step = 0
         self._resumed = False
         self._last_data_state = None
+        # True when the raised error is deterministic and hits every host at
+        # the same step (injection, non-finite grad from replicated metrics)
+        # — only then may the exit handler run a *coordinated* save on a pod.
+        self.error_is_replicated = False
         self._mesh_ctx = None
 
         # Handlers first — signals during the (potentially long) setup are
@@ -86,7 +90,7 @@ class Trainer:
         if cfg.checkpoint_id:
             logger.info(f"Loading checkpoint from {cfg.checkpoint_path}")
             read_mngr = CheckpointManager(cfg.checkpoint_path, cfg.checkpoint_id)
-        self.signal_flag.check(synced=self._sync_signals)
+        self.signal_flag.check()
 
         # --- data (ref: train.py:27-34) ---
         logger.info("Setting up DataLoaders...")
@@ -104,7 +108,7 @@ class Trainer:
                 bos_token_id=self.tokenizer.bos_token_id,
                 legacy=cfg.legacy_packing)
             self.loader = DataLoader(dataset, cfg.batch_size)
-        self.signal_flag.check(synced=self._sync_signals)
+        self.signal_flag.check()
 
         # --- model + optimizer (ref: train.py:42-77) ---
         logger.info("Setting up Model...")
@@ -151,7 +155,7 @@ class Trainer:
                                  out_shardings=self.state_shardings)(
                 jax.random.PRNGKey(cfg.seed))
             self._last_data_state = self.loader.get_state()
-        self.signal_flag.check(synced=self._sync_signals)
+        self.signal_flag.check()
 
         # Save manager for *this* job's id (ref naming: checkpoint_{JOBID},
         # utils.py:80) — files accumulate one dir per preemption, like the
@@ -225,6 +229,7 @@ class Trainer:
             if cfg.raise_error and self.training_step == cfg.error_step:
                 while inflight:
                     self._consume(*inflight.popleft())
+                self.error_is_replicated = True
                 raise Exception(
                     "Simulated exception to test signal handler", -1)
             self.training_step += 1
@@ -240,6 +245,8 @@ class Trainer:
         grad_norm = float(metrics["grad_norm"])
         if not math.isfinite(grad_norm):
             # ref: utils.py:61 error_if_nonfinite -> routed as code error (-1)
+            # grad_norm is a replicated global value: every host raises here
+            self.error_is_replicated = True
             raise NonFiniteGradientError(
                 f"non-finite gradient norm {grad_norm} at step {step_no}")
         self.throughput.step()
@@ -256,14 +263,20 @@ class Trainer:
 
     # --------------------------------------------------------------- saving
     def save_checkpoint(self, wait: bool = True,
-                        stop_prefetch: bool = True) -> int:
+                        stop_prefetch: bool = True,
+                        coordinated: bool = True) -> int:
         """Checkpoint the state of every *dispatched* step plus the matching
         data position. All dispatched XLA work completes by construction, so
         zero steps are lost (the reference's guarantee: saved @427, resumed
-        @427 — BASELINE.md)."""
+        @427 — BASELINE.md).
+
+        ``coordinated=False`` (exit handler, error of unknown provenance)
+        skips the pre-save barrier — on a pod the other hosts may still be
+        stepping and would never reach it."""
         if stop_prefetch:
             self.prefetcher.stop()
-        barrier("ftl:pre-save")  # all hosts drained to the same step
+        if coordinated:
+            barrier("ftl:pre-save")  # all hosts drained to the same step
         step = int(jax.device_get(self.state.step))
         data_state = self._last_data_state or self.loader.get_state()
         self.ckpt_mngr.save(step, self.state, data_state, wait=wait)

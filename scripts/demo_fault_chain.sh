@@ -1,0 +1,84 @@
+#!/bin/bash
+# Local (no-Slurm) reproduction of the reference's published evidence chain
+# (ref: logs/output_444664.out -> 444671 -> 444691):
+#
+#   job 1: training is "preempted" (USR1, the Slurm pre-timeout signal)
+#          -> checkpoint saved -> chain resubmitted
+#   job 2: resumes at the saved step with zero loss of steps
+#          -> deliberately injected error -> checkpoint saved, NO resubmit
+#   job 3: resumes again -> manual cancel (SIGTERM, scancel)
+#          -> terminates WITHOUT saving
+#
+# Produces logs/output_demo{1,2,3}.out with the same audit strings the
+# reference's logs carry, then asserts the chain: saved step == resumed
+# step (zero-step-loss), resubmit marker exists, and job 3 wrote nothing.
+#
+# Runs on CPU in ~2 min (tiny model, byte tokenizer, synthetic parquet).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+WORK=${DEMO_WORKDIR:-/tmp/ftl_demo}
+rm -rf "$WORK"
+mkdir -p "$WORK" logs
+
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS || true
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_test_compile_cache}
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+
+python - <<EOF
+import numpy as np, pyarrow as pa, pyarrow.parquet as pq
+rng = np.random.default_rng(0)
+words = ['alpha','bravo','charlie','delta','echo','foxtrot']
+docs = [' '.join(rng.choice(words, size=int(rng.integers(20,200)))) for _ in range(256)]
+pq.write_table(pa.table({'text': docs}), '$WORK/train_data.parquet')
+EOF
+
+COMMON=(--dataset "$WORK/train_data.parquet" --checkpoint-path "$WORK/ckpts"
+        --tokenizer-name-or-path byte --model tiny --sequence-length 128
+        --batch-size 2 --logging-frequency 10)
+
+# --- job 1: preemption (USR1 ~ Slurm's --signal=USR1@120) ------------------
+echo "== job 1: preempt with USR1 -> save + resubmit"
+SLURM_JOB_ID=demo1 python train.py "${COMMON[@]}" --training-steps 100000 \
+  --resubmit-command "touch $WORK/resubmitted" \
+  > logs/output_demo1.out 2>&1 &
+PID=$!
+sleep 20          # let it train a while (compile + some hundreds of steps)
+kill -USR1 $PID   # what Slurm sends 120 s before the time limit
+wait $PID
+
+# --- job 2: resume, then hit the injected fault ----------------------------
+SAVED=$(grep -oP 'Checkpoint saved at step \K\d+' logs/output_demo1.out)
+ERR=$((SAVED + 200))
+echo "== job 2: resume from step $SAVED -> injected error at $ERR"
+SLURM_JOB_ID=demo2 python train.py "${COMMON[@]}" --training-steps 100000 \
+  --checkpoint-id demo1 --raise-error --error-step "$ERR" \
+  > logs/output_demo2.out 2>&1
+
+# --- job 3: resume again, then scancel (SIGTERM) ---------------------------
+echo "== job 3: resume -> scancel (TERM) -> terminate without saving"
+SLURM_JOB_ID=demo3 python train.py "${COMMON[@]}" --training-steps 100000 \
+  --checkpoint-id demo2 \
+  > logs/output_demo3.out 2>&1 &
+PID=$!
+sleep 15
+kill -TERM $PID   # what scancel sends
+wait $PID
+
+# --- assertions (the reference verifies these by reading logs; here they
+# --- are machine-checked — SURVEY.md §4 upgrade) ---------------------------
+echo "== assertions"
+grep -q "Job timed out, saving checkpoint" logs/output_demo1.out
+grep -q "sbatch requeued" logs/output_demo1.out
+test -f "$WORK/resubmitted"
+RESUMED=$(grep -oP 'Resuming training from training_step \K\d+' logs/output_demo2.out)
+[ "$SAVED" = "$RESUMED" ]   # zero steps lost (ref: saved @427, resumed @427)
+grep -q "Error during training encountered, saving checkpoint" logs/output_demo2.out
+! grep -q "sbatch requeued" logs/output_demo2.out   # code error: no resubmit
+SAVED2=$(grep -oP 'Checkpoint saved at step \K\d+' logs/output_demo2.out)
+RESUMED2=$(grep -oP 'Resuming training from training_step \K\d+' logs/output_demo3.out)
+[ "$SAVED2" = "$RESUMED2" ]
+grep -q "Job cancelled, terminating" logs/output_demo3.out
+! grep -q "saving checkpoint" logs/output_demo3.out  # cancel: no save
+echo "OK: preempt->save@$SAVED->resume@$RESUMED->error@$ERR->save@$SAVED2->resume@$RESUMED2->cancel"

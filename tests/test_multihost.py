@@ -47,6 +47,40 @@ def test_synced_check_raises_same_signal():
     flag.check(synced=True)  # cleared after raise
 
 
+class _StubTrainer:
+    def __init__(self, replicated):
+        self.state = object()
+        self.error_is_replicated = replicated
+        self.saved_with = None
+        self.cfg = type("C", (), {"resubmit_command": "true"})()
+
+    def save_checkpoint(self, wait=True, coordinated=True):
+        self.saved_with = dict(wait=wait, coordinated=coordinated)
+        return 7
+
+
+def test_host_local_error_skips_coordinated_save(monkeypatch, caplog):
+    """On a pod, an error of unknown provenance must not enter the pre-save
+    barrier (the other hosts never reach it); replicated errors may."""
+    import logging
+
+    import jax
+
+    from fault_tolerant_llm_training_tpu.ft import handler
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    logger = logging.getLogger()
+    with caplog.at_level(logging.INFO):
+        t = _StubTrainer(replicated=False)
+        handler.handle_exit(t, handler.CODE_ERROR, logger)
+        assert t.saved_with is None
+        assert any("cannot write a coordinated checkpoint" in r.message
+                   for r in caplog.records)
+    t = _StubTrainer(replicated=True)
+    handler.handle_exit(t, handler.CODE_ERROR, logger)
+    assert t.saved_with == dict(wait=True, coordinated=True)
+
+
 _WORKER = """
 import os, sys
 os.environ.pop('PALLAS_AXON_POOL_IPS', None)
@@ -74,18 +108,23 @@ def test_two_process_agreement(tmp_path):
     import subprocess
     import sys
 
-    with socket.socket() as s:  # free port for the coordination service
-        s.bind(("localhost", 0))
-        coord = f"localhost:{s.getsockname()[1]}"
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {**os.environ, "PYTHONPATH": repo_root}
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(i), coord],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for i in range(2)]
-    outs = [p.communicate(timeout=120)[0] for p in procs]
+    # bind-then-close port discovery has a TOCTOU race with other processes
+    # on the machine — retry with a fresh port on failure
+    for attempt in range(3):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            coord = f"localhost:{s.getsockname()[1]}"
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(i), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        if all(p.returncode == 0 for p in procs):
+            break
     assert all(p.returncode == 0 for p in procs), outs
     assert "verdict=10 resubmit=True" in outs[0], outs[0]
     assert "verdict=10 resubmit=False" in outs[1], outs[1]
