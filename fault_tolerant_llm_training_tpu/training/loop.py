@@ -76,8 +76,9 @@ class Trainer:
 
         if cfg.distributed:
             jax.distributed.initialize()
-        # Multihost: every signal check must be a cluster-wide agreement
-        # (ft/multihost.py) so all hosts raise at the same boundary.
+        # Multihost: in-loop signal checks are cluster-wide agreements
+        # (ft/multihost.py) so all hosts raise at the same boundary; setup
+        # checks are local-only and skipped on pods (see _setup_check).
         self._sync_signals = jax.process_count() > 1
 
         self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp)
@@ -90,7 +91,7 @@ class Trainer:
         if cfg.checkpoint_id:
             logger.info(f"Loading checkpoint from {cfg.checkpoint_path}")
             read_mngr = CheckpointManager(cfg.checkpoint_path, cfg.checkpoint_id)
-        self.signal_flag.check()
+        self._setup_check()
 
         # --- data (ref: train.py:27-34) ---
         logger.info("Setting up DataLoaders...")
@@ -108,7 +109,7 @@ class Trainer:
                 bos_token_id=self.tokenizer.bos_token_id,
                 legacy=cfg.legacy_packing)
             self.loader = DataLoader(dataset, cfg.batch_size)
-        self.signal_flag.check()
+        self._setup_check()
 
         # --- model + optimizer (ref: train.py:42-77) ---
         logger.info("Setting up Model...")
@@ -155,7 +156,7 @@ class Trainer:
                                  out_shardings=self.state_shardings)(
                 jax.random.PRNGKey(cfg.seed))
             self._last_data_state = self.loader.get_state()
-        self.signal_flag.check()
+        self._setup_check()
 
         # Save manager for *this* job's id (ref naming: checkpoint_{JOBID},
         # utils.py:80) — files accumulate one dir per preemption, like the
@@ -184,6 +185,22 @@ class Trainer:
         self.throughput = Throughput(
             tokens_per_step=cfg.batch_size * cfg.sequence_length)
 
+    def _setup_check(self) -> None:
+        """Phase-boundary signal check during setup.
+
+        Single-host: raise now, closing the reference's unprotected-setup
+        window (train.py:42-84 runs ~35 s before handlers exist).
+        Multihost: never raise *alone* during setup — a lone raise strands
+        the other hosts in their next collective, and a collective check
+        here hangs survivors if one host's setup fails. The pending signal
+        (only possible from the microsecond window before ``deferred()``
+        engaged — setup signals are OS-blocked) is instead handled at the
+        loop's first synced boundary, with a fully-built trainer that can
+        run the coordinated save.
+        """
+        if not self._sync_signals:
+            self.signal_flag.check()
+
     # ------------------------------------------------------------------ run
     def run(self) -> None:
         cfg = self.cfg
@@ -206,6 +223,7 @@ class Trainer:
         inflight = collections.deque()
         it = iter(self.prefetcher)
         sync_freq = max(1, cfg.signal_sync_frequency)
+        first_iteration = True
         while self.training_step < cfg.training_steps:
             if self._sync_signals:
                 # Cluster-wide agreement only at sync boundaries: the
@@ -213,10 +231,14 @@ class Trainer:
                 # dispatch pipeline (see TrainConfig.signal_sync_frequency).
                 # Off-boundary local raises are skipped — a host raising
                 # alone would deadlock the others in the next collective.
-                if self.training_step % sync_freq == 0:
+                # The first iteration always syncs so a signal pending
+                # since before setup (see _setup_check) is handled
+                # immediately even when the resumed step is off-boundary.
+                if first_iteration or self.training_step % sync_freq == 0:
                     self.signal_flag.check(synced=True)
             else:
                 self.signal_flag.check()
+            first_iteration = False
             inputs, labels, data_state = next(it)
             self.state, metrics = self._compiled_step(self.state, inputs,
                                                       labels)
