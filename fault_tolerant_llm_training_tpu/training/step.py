@@ -30,8 +30,11 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array
     logits = logits.astype(jnp.float32)
     valid = labels != IGNORE_INDEX
     safe_labels = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    # logsumexp-minus-picked-logit form: identical to -log_softmax[label]
+    # but never materializes the (B, S, V) fp32 log-probability tensor —
+    # the V axis is reduced away immediately, which matters at vocab 131k
+    # (HBM bandwidth, SURVEY.md §2.2).
+    nll = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
     num_valid = jnp.sum(valid)
     loss = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(num_valid, 1)
     return loss, num_valid
