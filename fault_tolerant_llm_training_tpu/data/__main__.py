@@ -1,0 +1,86 @@
+"""Data-layer smoke harness (ref: dataset.py:104-166 — the reference's only
+executable "test").
+
+Mirrors the reference's ``__main__`` block: decode one sample, run one batch
+through *both* dataset classes (map-style + packed iterable), and print shapes
+plus the -100 loss-mask percentage. Upgrade over the reference (SURVEY.md §4):
+it is hermetic — with no ``--dataset`` it synthesizes a parquet file, and the
+default tokenizer is the offline byte tokenizer, so it runs with no cluster
+filesystem and no network.
+
+    python -m fault_tolerant_llm_training_tpu.data [--dataset X.parquet]
+        [--tokenizer-name-or-path byte] [--sequence-length 128]
+        [--batch-size 2]
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+from .collator import CollatorForCLM
+from .loader import DataLoader
+from .parquet import IterableParquetDataset, ParquetDataset
+from .tokenizer import load_tokenizer
+
+
+def _synthesize_parquet(path: str, n_docs: int = 64) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    words = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot",
+             "golf", "hotel", "india", "juliet"]
+    docs = [" ".join(rng.choice(words, size=int(rng.integers(5, 120))))
+            for _ in range(n_docs)]
+    pq.write_table(pa.table({"text": docs}), path)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dataset", type=str, default="",
+                   help="parquet file with a 'text' column; default: synthetic")
+    p.add_argument("--tokenizer-name-or-path", type=str, default="byte")
+    p.add_argument("--sequence-length", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=2)
+    args = p.parse_args(argv)
+
+    tmp = None
+    dataset_path = args.dataset
+    if not dataset_path:
+        tmp = tempfile.NamedTemporaryFile(suffix=".parquet", delete=False)
+        dataset_path = tmp.name
+        _synthesize_parquet(dataset_path)
+        print(f"synthesized dataset: {dataset_path}")
+
+    tok = load_tokenizer(args.tokenizer_name_or_path)
+    seq, bs = args.sequence_length, args.batch_size
+
+    # --- map-style path (ref: dataset.py:119-143) ---
+    ds = ParquetDataset(dataset_path, tok, seq, training_samples=bs * 4)
+    sample = ds[0]
+    decoded = tok.decode([t for t in sample["input_ids"]
+                          if t != tok.pad_token_id])
+    print(f"[map] decoded sample 0 (first 80 chars): {decoded[:80]!r}")
+    collator = CollatorForCLM(seq, tok.pad_token_id)
+    inputs, labels = next(iter(DataLoader(ds, bs, collator)))
+    masked = float((labels == -100).mean()) * 100
+    print(f"[map] batch: inputs {inputs.shape} {inputs.dtype}, "
+          f"labels {labels.shape}; -100 mask: {masked:.1f}%")
+
+    # --- packed iterable path (ref: dataset.py:146-166) ---
+    for legacy in (True, False):
+        it = IterableParquetDataset(dataset_path, tok, seq,
+                                    bos_token_id=tok.bos_token_id,
+                                    legacy=legacy)
+        inputs, labels = next(iter(DataLoader(it, bs)))
+        masked = float((labels == -100).mean()) * 100
+        mode = "legacy (reference quirks)" if legacy else "fixed"
+        print(f"[packed/{mode}] batch: inputs {inputs.shape} {inputs.dtype}, "
+              f"labels {labels.shape}; -100 mask (BOS): {masked:.1f}%")
+
+    print("data smoke test OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -131,3 +131,15 @@ def test_dataset_state_roundtrip_packed(tiny_parquet, tok):
         got = next(loader2)
         np.testing.assert_array_equal(want[0], got[0])
         np.testing.assert_array_equal(want[1], got[1])
+
+
+def test_smoke_harness_runs(tiny_parquet, capsys):
+    """The runnable data smoke test (ref: dataset.py:104-166) exercises both
+    dataset classes and reports the loss-mask percentage."""
+    from fault_tolerant_llm_training_tpu.data.__main__ import main
+
+    main(["--dataset", tiny_parquet, "--sequence-length", "64",
+          "--batch-size", "2"])
+    out = capsys.readouterr().out
+    assert "data smoke test OK" in out
+    assert "[map] batch" in out and "[packed/fixed]" in out
