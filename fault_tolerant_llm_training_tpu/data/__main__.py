@@ -14,6 +14,7 @@ filesystem and no network.
 """
 
 import argparse
+import os
 import tempfile
 
 import numpy as np
@@ -45,15 +46,27 @@ def main(argv=None) -> None:
     p.add_argument("--batch-size", type=int, default=2)
     args = p.parse_args(argv)
 
-    tmp = None
+    synthesized = None
     dataset_path = args.dataset
     if not dataset_path:
-        tmp = tempfile.NamedTemporaryFile(suffix=".parquet", delete=False)
-        dataset_path = tmp.name
+        fd, dataset_path = tempfile.mkstemp(suffix=".parquet")
+        os.close(fd)
+        synthesized = dataset_path
         _synthesize_parquet(dataset_path)
         print(f"synthesized dataset: {dataset_path}")
 
     tok = load_tokenizer(args.tokenizer_name_or_path)
+    # HF tokenizers may lack pad/bos tokens (e.g. gpt2 has neither); the
+    # harness needs both, so substitute usable ids rather than crash in
+    # encode_plus / pack_clm.
+    if tok.pad_token_id is None:
+        tok.pad_token = (tok.eos_token if getattr(tok, "eos_token", None)
+                         else "<|pad|>")
+        print(f"tokenizer has no pad token; using id {tok.pad_token_id}")
+    bos_id = tok.bos_token_id
+    if bos_id is None:
+        bos_id = tok.eos_token_id if tok.eos_token_id is not None else tok.pad_token_id
+        print(f"tokenizer has no BOS token; packing with id {bos_id}")
     seq, bs = args.sequence_length, args.batch_size
 
     # --- map-style path (ref: dataset.py:119-143) ---
@@ -71,7 +84,7 @@ def main(argv=None) -> None:
     # --- packed iterable path (ref: dataset.py:146-166) ---
     for legacy in (True, False):
         it = IterableParquetDataset(dataset_path, tok, seq,
-                                    bos_token_id=tok.bos_token_id,
+                                    bos_token_id=bos_id,
                                     legacy=legacy)
         inputs, labels = next(iter(DataLoader(it, bs)))
         masked = float((labels == -100).mean()) * 100
@@ -79,6 +92,8 @@ def main(argv=None) -> None:
         print(f"[packed/{mode}] batch: inputs {inputs.shape} {inputs.dtype}, "
               f"labels {labels.shape}; -100 mask (BOS): {masked:.1f}%")
 
+    if synthesized is not None:
+        os.unlink(synthesized)
     print("data smoke test OK")
 
 

@@ -31,6 +31,11 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16  # activations / compute
     param_dtype: jnp.dtype = jnp.bfloat16  # weights (and hence AdamW moments)
     attention_impl: str = "auto"
+    # Token-embedding lookup: "gather" (jnp.take), "one_hot" (iota one-hot
+    # matmul — contracts the vocab axis on the MXU with a psum, which is how
+    # a vocab-sharded table must be read under tensor parallelism), or
+    # "auto" (one_hot iff the mesh's tensor axis is >1).
+    embed_impl: str = "auto"
     remat: bool = False
 
     @property

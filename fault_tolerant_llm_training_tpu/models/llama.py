@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.attention import multihead_attention
-from ..ops.rope import apply_rope, precompute_rope
+from ..ops.rope import apply_rope, precompute_rope, rope_cos_sin
 from ..parallel.mesh import mesh_axis_size
 from ..parallel.sharding import constrain
 from .configs import TransformerConfig
@@ -49,6 +49,32 @@ class RMSNorm(nn.Module):
         return normed.astype(x.dtype) * scale.astype(x.dtype)
 
 
+class TokenEmbed(nn.Module):
+    """Token embedding (ref: model.py:340 ``nn.Embedding``).
+
+    Two lookups behind ``cfg.embed_impl``: a plain gather, or an iota
+    one-hot matmul. The matmul form matters under tensor parallelism where
+    the (vocab, embed) table is vocab-sharded: contracting the vocab axis is
+    a clean MXU matmul + psum, whereas a gather from a vocab-sharded table
+    forces the SPMD partitioner into an involuntary full rematerialization
+    (observed on the dp/fsdp/sp/tp dryrun mesh)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        emb = self.param("embedding", _EMBED_INIT,
+                         (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        impl = cfg.embed_impl
+        if impl == "auto":
+            impl = "one_hot" if mesh_axis_size("tensor") > 1 else "gather"
+        if impl == "one_hot":
+            one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+            return one_hot @ emb.astype(cfg.dtype)
+        return jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+
+
 class Attention(nn.Module):
     """GQA causal self-attention (ref: model.py:129-215)."""
 
@@ -68,11 +94,16 @@ class Attention(nn.Module):
         k = k.reshape(b, s, cfg.kv_heads, dh)
         v = v.reshape(b, s, cfg.kv_heads, dh)
 
-        # RoPE table rows: with sequence parallelism each shard holds a
-        # non-prefix slice, so positions index the full-length table.
-        cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+        # With sequence parallelism each shard holds a non-prefix slice of
+        # the sequence; cos/sin come from a positions x freqs outer product
+        # (sharded with the activations) rather than a table gather, which
+        # the SPMD partitioner can only reshard by full rematerialization.
+        if positions is None:
+            cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
+        else:
+            cos, sin = rope_cos_sin(dh, cfg.rope_theta, positions)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
 
         impl = cfg.attention_impl
         if impl in ("auto", "ring") and mesh_axis_size("sequence") > 1:
@@ -128,9 +159,7 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, tokens, positions=None):
         cfg = self.cfg
-        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, embedding_init=_EMBED_INIT,
-                     name="tok_embeddings")(tokens)
+        x = TokenEmbed(cfg, name="tok_embeddings")(tokens)
         x = constrain(x, "batch", "seq", "act_embed")
         block = TransformerBlock
         if cfg.remat:

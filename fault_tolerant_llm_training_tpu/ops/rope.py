@@ -21,6 +21,11 @@ from typing import Tuple
 import jax.numpy as jnp
 
 
+def rope_freqs(head_dim: int, theta: float) -> jnp.ndarray:
+    """(D/2,) inverse frequencies theta^(-2j/d) (ref: model.py:67-69)."""
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
 def precompute_rope(head_dim: int, seq_len: int, theta: float = 10000.0
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(cos, sin) tables of shape (seq_len, head_dim // 2), fp32.
@@ -30,9 +35,23 @@ def precompute_rope(head_dim: int, seq_len: int, theta: float = 10000.0
     non-persistent buffer (model.py:342-344); here it is a constant folded
     into the jitted step.
     """
-    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     t = jnp.arange(seq_len, dtype=jnp.float32)
-    angles = jnp.outer(t, freqs)  # (S, D/2)
+    angles = jnp.outer(t, rope_freqs(head_dim, theta))  # (S, D/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def rope_cos_sin(head_dim: int, theta: float, positions: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, S, D/2) cos/sin computed directly from ``positions`` (B, S).
+
+    An outer product instead of a table gather: under sequence parallelism
+    the positions array is sharded along S, and XLA shards this elementwise
+    compute with it — whereas a ``table[positions]`` gather forces an
+    involuntary full rematerialization when the table's sharding does not
+    match the activations' (observed in the SPMD partitioner on the
+    dp/fsdp/sp/tp dryrun mesh).
+    """
+    angles = positions.astype(jnp.float32)[..., None] * rope_freqs(head_dim, theta)
     return jnp.cos(angles), jnp.sin(angles)
 
 
@@ -40,21 +59,26 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
                positions: jnp.ndarray = None) -> jnp.ndarray:
     """Rotate ``x`` of shape (B, S, H, D) by the interleaved-pair convention.
 
-    ``cos``/``sin`` are (S_table, D/2); the first S rows are used (the
-    reference slices its table to the runtime seqlen, model.py:91-97), or
-    ``positions`` (B, S) selects rows explicitly (needed by ring attention,
-    where each shard holds a non-prefix slice of the sequence).
+    ``cos``/``sin`` are (S_table, D/2) — the first S rows are used (the
+    reference slices its table to the runtime seqlen, model.py:91-97) — or
+    per-token (B, S, D/2) from :func:`rope_cos_sin` (needed under sequence
+    parallelism, where each shard holds a non-prefix slice of the sequence).
+    ``positions`` (B, S) selects table rows explicitly via gather; prefer
+    :func:`rope_cos_sin` inside sharded code (see its docstring).
     """
     orig_dtype = x.dtype
     b, s, h, d = x.shape
     xf = x.astype(jnp.float32).reshape(b, s, h, d // 2, 2)
     x_even, x_odd = xf[..., 0], xf[..., 1]
-    if positions is None:
-        c = cos[:s][None, :, None, :]  # (1, S, 1, D/2)
-        si = sin[:s][None, :, None, :]
-    else:
+    if positions is not None:
         c = cos[positions][:, :, None, :]  # (B, S, 1, D/2)
         si = sin[positions][:, :, None, :]
+    elif cos.ndim == 3:
+        c = cos[:, :, None, :]  # (B, S, 1, D/2) per-token form
+        si = sin[:, :, None, :]
+    else:
+        c = cos[:s][None, :, None, :]  # (1, S, 1, D/2)
+        si = sin[:s][None, :, None, :]
     out_even = x_even * c - x_odd * si
     out_odd = x_even * si + x_odd * c
     out = jnp.stack([out_even, out_odd], axis=-1).reshape(b, s, h, d)

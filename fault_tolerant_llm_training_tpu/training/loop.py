@@ -119,7 +119,8 @@ class Trainer:
         self.model_config = get_config(
             cfg.model, vocab_size=vocab, seq_len=cfg.sequence_length,
             dtype=dtype, param_dtype=param_dtype,
-            attention_impl=cfg.attention_impl, remat=cfg.remat)
+            attention_impl=cfg.attention_impl, embed_impl=cfg.embed_impl,
+            remat=cfg.remat)
         self.model = Transformer(self.model_config)
         self.optimizer = make_optimizer(cfg.learning_rate, cfg.lr_warmup_steps)
 

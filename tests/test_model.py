@@ -108,3 +108,19 @@ def test_remat_same_output():
     l1 = m.apply({"params": params}, t)
     l2 = m_remat.apply({"params": params}, t)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)
+
+
+def test_embed_one_hot_matches_gather():
+    # The iota one-hot matmul embedding (used under tensor parallelism, where
+    # the vocab-sharded table cannot be gathered efficiently) must equal the
+    # plain gather lookup.
+    cfg = get_config("tiny", attention_impl="xla", dtype=jnp.float32,
+                     param_dtype=jnp.float32, embed_impl="gather")
+    t = jnp.asarray(np.random.default_rng(1).integers(0, 512, (2, 16)))
+    m = Transformer(cfg)
+    params = m.init(jax.random.PRNGKey(0), t)["params"]
+    l_gather = m.apply({"params": params}, t)
+    l_onehot = Transformer(cfg.replace(embed_impl="one_hot")).apply(
+        {"params": params}, t)
+    np.testing.assert_allclose(np.asarray(l_gather), np.asarray(l_onehot),
+                               rtol=1e-6, atol=1e-6)

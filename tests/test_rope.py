@@ -5,7 +5,11 @@ independent numpy complex-exponential implementation of the reference's math
 import jax.numpy as jnp
 import numpy as np
 
-from fault_tolerant_llm_training_tpu.ops.rope import apply_rope, precompute_rope
+from fault_tolerant_llm_training_tpu.ops.rope import (
+    apply_rope,
+    precompute_rope,
+    rope_cos_sin,
+)
 
 
 def numpy_complex_rope(x: np.ndarray, theta: float) -> np.ndarray:
@@ -56,3 +60,18 @@ def test_rope_positions_indexing():
                        axis=1), 10000.0)
     np.testing.assert_allclose(np.asarray(shifted), oracle_full[:, 4:],
                                rtol=1e-5, atol=1e-5)
+
+
+def test_rope_cos_sin_matches_table_gather():
+    # The gather-free per-token form (used under sequence parallelism) must
+    # equal indexing the precomputed table at the same positions.
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 8, 2, 8)).astype(np.float32))
+    positions = jnp.asarray(rng.integers(0, 32, (2, 8)).astype(np.int32))
+    table_cos, table_sin = precompute_rope(8, 32, 500000.0)
+    via_gather = apply_rope(x, table_cos, table_sin, positions=positions)
+    cos, sin = rope_cos_sin(8, 500000.0, positions)
+    assert cos.shape == (2, 8, 4)
+    via_outer = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(np.asarray(via_outer), np.asarray(via_gather),
+                               rtol=1e-5, atol=1e-6)
