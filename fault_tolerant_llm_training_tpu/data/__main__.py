@@ -60,8 +60,12 @@ def main(argv=None) -> None:
     # harness needs both, so substitute usable ids rather than crash in
     # encode_plus / pack_clm.
     if tok.pad_token_id is None:
-        tok.pad_token = (tok.eos_token if getattr(tok, "eos_token", None)
-                         else "<|pad|>")
+        if getattr(tok, "eos_token", None):
+            tok.pad_token = tok.eos_token
+        else:
+            # add_special_tokens registers the new token in the vocab;
+            # plain `tok.pad_token = ...` would leave pad_token_id None.
+            tok.add_special_tokens({"pad_token": "<|pad|>"})
         print(f"tokenizer has no pad token; using id {tok.pad_token_id}")
     bos_id = tok.bos_token_id
     if bos_id is None:

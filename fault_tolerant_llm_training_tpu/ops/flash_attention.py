@@ -15,32 +15,63 @@ BlockSpec index map — KV are never repeated in memory (the reference's
 kernel runs one grid step per *KV* head and accumulates its query-head group
 in-kernel, so gradients are written at native KV-head granularity.
 
+VPU economy (attention at head_dim 64 is VPU-bound on TPU, not MXU-bound):
+
+- The causal mask (two iotas + compare + select per (bq, bk) tile) is applied
+  only to *diagonal* k-blocks; the k-loop is split into a full-block phase
+  with no masking and a masked tail. For bq == bk that is one masked block
+  per q-tile instead of all of them.
+- Softmax runs in base 2: ``log2(e)`` is folded into the per-tile q scaling
+  (one (bq, D) multiply) so the inner loop's only transcendental is a bare
+  ``exp2`` — no per-element score scaling at all. The saved logsumexp is
+  base-2 as well; it is a kernel-internal residual, consumed only by the
+  backward kernels which recompute probabilities as ``exp2(s2 - lse2)``.
+  Backward accumulators run unscaled and are rescaled once per tile at the
+  final write (exact: the accumulation is linear).
+
 lse/delta carry a trailing singleton dim — (B, H, S, 1) — because the Pallas
 TPU lowering requires a block's last two dims to be (8k, 128m)-tileable or
 full; (block_q, 1) satisfies that where rank-3 (1, 1, block_q) does not.
 """
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# Tile sizes tuned on TPU v5e at S=2048, D=64 (see BASELINE.md); each kernel
+# has its own operating point because the blocks play different roles: the
+# q-tile is the grid unit in fwd/dq but the loop chunk in dkv, and vice versa.
+FWD_BLOCK_Q, FWD_BLOCK_K = 1024, 256
+DQ_BLOCK_Q, DQ_BLOCK_K = 512, 512
+DKV_BLOCK_Q, DKV_BLOCK_K = 512, 1024
 NEG_INF = -1e30
+LOG2E = math.log2(math.e)
+LN2 = math.log(2.0)
 
 
-def _masked_scores(q, k, q_start, k_start, scale, causal):
-    """Scaled q @ k^T scores (fp32) with the causal mask applied.
+def _prescale_q(q_ref_slice, scale):
+    """Pre-scale a q tile by scale*log2(e) (base-2 softmax, see module doc).
 
-    Shared by the forward and both backward kernels so masking/scaling can
-    never desynchronize between them. q: (bq, D), k: (bk, D) -> (bq, bk).
+    Single source of truth for the rounding: the backward's exp2(s - lse) is
+    exact only if every kernel scales (and rounds) q identically.
+    """
+    return (q_ref_slice.astype(jnp.float32) * (scale * LOG2E)).astype(
+        q_ref_slice.dtype)
+
+
+def _scores(q2, k, q_start, k_start, masked):
+    """q2 @ k^T base-2 scores (fp32); q2 is pre-scaled by scale*log2(e).
+
+    Applies the causal select only when ``masked`` (diagonal blocks).
+    q2: (bq, D), k: (bk, D) -> (bq, bk).
     """
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    if causal:
+        q2, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if masked:
         bq, bk = s.shape
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -48,32 +79,41 @@ def _masked_scores(q, k, q_start, k_start, scale, causal):
     return s
 
 
-def _causal_k_blocks(q_start, block_q, s_k, block_k, causal):
-    """Number of k-blocks a q-tile starting at ``q_start`` attends to."""
+def _k_block_bounds(q_start, block_q, s_k, block_k, causal):
+    """(n_full, n_total) k-block counts for a q-tile at ``q_start``.
+
+    Blocks [0, n_full) are fully attended (no mask needed); blocks
+    [n_full, n_total) straddle the diagonal and need the causal select.
+    A k-block [ks, ks+bk) is full iff ks + bk - 1 <= q_start (its every key
+    is visible to the tile's *first* row, hence to all rows).
+    """
+    n_blocks = s_k // block_k
     if not causal:
-        return s_k // block_k
-    return jnp.minimum(
-        (q_start + block_q + block_k - 1) // block_k, s_k // block_k)
+        return n_blocks, n_blocks
+    n_total = jnp.minimum(
+        (q_start + block_q + block_k - 1) // block_k, n_blocks)
+    n_full = jnp.minimum(q_start // block_k, n_total)
+    return n_full, n_total
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 scale: float, causal: bool):
     # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D);
     # lse_ref: (1, 1, block_q, 1)
-    q = q_ref[0, 0]
-    block_q, d = q.shape
+    q2 = _prescale_q(q_ref[0, 0], scale)
+    block_q, d = q2.shape
     s_k = k_ref.shape[2]
     q_start = pl.program_id(2) * block_q
-    num_k_blocks = _causal_k_blocks(q_start, block_q, s_k, block_k, causal)
+    n_full, n_total = _k_block_bounds(q_start, block_q, s_k, block_k, causal)
 
-    def body(j, carry):
+    def body(j, carry, masked):
         m_prev, l_prev, acc_prev = carry
         k_start = j * block_k
         k = k_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = _masked_scores(q, k, q_start, k_start, scale, causal)
+        s = _scores(q2, k, q_start, k_start, masked)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
         acc_new = acc_prev * alpha[:, None] + jax.lax.dot_general(
@@ -84,41 +124,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     init = (jnp.full((block_q,), NEG_INF, jnp.float32),
             jnp.zeros((block_q,), jnp.float32),
             jnp.zeros((block_q, d), jnp.float32))
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, init)
+    carry = jax.lax.fori_loop(
+        0, n_full, functools.partial(body, masked=False), init)
+    m, l, acc = jax.lax.fori_loop(
+        n_full, n_total, functools.partial(body, masked=causal), carry)
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+    lse_ref[0, 0] = (m + jnp.log2(l))[:, None]  # base-2, internal only
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                block_k: int, scale: float, causal: bool):
     # q/do/dq: (1, 1, block_q, D); k/v: (1, 1, S, D);
     # lse/delta: (1, 1, block_q, 1)
-    q = q_ref[0, 0]
+    q2 = _prescale_q(q_ref[0, 0], scale)
     do = do_ref[0, 0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
-    block_q, d = q.shape
+    block_q, d = q2.shape
     s_k = k_ref.shape[2]
     q_start = pl.program_id(2) * block_q
-    num_k_blocks = _causal_k_blocks(q_start, block_q, s_k, block_k, causal)
+    n_full, n_total = _k_block_bounds(q_start, block_q, s_k, block_k, causal)
 
-    def body(j, dq_acc):
+    def body(j, dq_acc, masked):
         k_start = j * block_k
         k = k_ref[0, 0, pl.ds(k_start, block_k), :]
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = _masked_scores(q, k, q_start, k_start, scale, causal)
-        p = jnp.exp(s - lse)  # exact probabilities; lse is (block_q, 1)
+        s = _scores(q2, k, q_start, k_start, masked)
+        p = jnp.exp2(s - lse)  # exact probabilities; lse is (block_q, 1)
         dp = jax.lax.dot_general(  # dO @ V^T: (block_q, block_k)
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)  # unscaled; dq rescaled once at the write
         return dq_acc + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, num_k_blocks, body,
+    dq = jax.lax.fori_loop(0, n_full, functools.partial(body, masked=False),
                            jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    dq = jax.lax.fori_loop(n_full, n_total,
+                           functools.partial(body, masked=causal), dq)
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -132,44 +177,71 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s_q = q_ref.shape[2]
     k_start = pl.program_id(2) * block_k
     n_q_blocks = s_q // block_q
-    # Causal: q blocks strictly before this k block contribute nothing.
-    j_start = k_start // block_q if causal else 0
+    # Causal split of the q range: q-blocks strictly before this k-block
+    # contribute nothing; blocks straddling the diagonal need the mask;
+    # q-blocks whose first row is >= k_start + block_k - 1 are full.
+    if causal:
+        j_start = k_start // block_q
+        j_full = jnp.minimum(
+            (k_start + block_k - 1 + block_q - 1) // block_q, n_q_blocks)
+    else:
+        j_start, j_full = 0, 0
 
-    def body(j, carry):
+    def body(j, carry, masked):
         dk_acc, dv_acc = carry
         q_start = j * block_q
         for g in range(group):  # static loop: accumulate the GQA group
-            q = q_ref[0, g, pl.ds(q_start, block_q), :]
+            q2 = _prescale_q(q_ref[0, g, pl.ds(q_start, block_q), :], scale)
             do = do_ref[0, g, pl.ds(q_start, block_q), :]
             lse = lse_ref[0, g, pl.ds(q_start, block_q), :]
             delta = delta_ref[0, g, pl.ds(q_start, block_q), :]
-            s = _masked_scores(q, k, q_start, k_start, scale, causal)
-            p = jnp.exp(s - lse)  # lse is (block_q, 1)
+            s = _scores(q2, k, q_start, k_start, masked)
+            p = jnp.exp2(s - lse)  # lse is (block_q, 1)
             dv_acc = dv_acc + jax.lax.dot_general(  # P^T @ dO
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(  # dO @ V^T
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - delta) * scale
-            dk_acc = dk_acc + jax.lax.dot_general(  # dS^T @ Q
-                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds = p * (dp - delta)
+            # dk (true) = (ds*scale)^T @ q_raw = ds^T @ q2 * ln(2), since
+            # q2 = q_raw * scale * log2(e); rescaled once at the write.
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
     init = (jnp.zeros((block_k, d), jnp.float32),
             jnp.zeros((block_k, d), jnp.float32))
-    dk, dv = jax.lax.fori_loop(j_start, n_q_blocks, body, init)
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    carry = jax.lax.fori_loop(
+        j_start, j_full, functools.partial(body, masked=causal), init)
+    dk, dv = jax.lax.fori_loop(
+        j_full if causal else 0, n_q_blocks,
+        functools.partial(body, masked=False), carry)
+    dk_ref[0, 0] = (dk * LN2).astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
+def _fit_block(s, block):
+    """Largest usable tile size <= ``block`` for a sequence of length ``s``.
+
+    The tuned defaults are large (up to 1024); a sequence length they don't
+    divide (e.g. 1536) degrades to a smaller tile instead of failing. Tiles
+    must divide ``s`` and satisfy the TPU tiling rule from the module doc —
+    a multiple of 8 sublanes, or the full dim; if no such divisor exists
+    (e.g. prime ``s``), the whole sequence becomes one tile."""
+    block = min(block, s)
+    if s % block == 0:
+        return block
+    best = s  # "full" is always a legal tile
+    for b in range(8, block + 1, 8):
+        if s % b == 0:
+            best = b
+    return best
+
+
 def _blocks(s, block_q, block_k):
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0, (
-        f"seq len {s} must be divisible by block sizes ({block_q}, {block_k})")
-    return block_q, block_k
+    return _fit_block(s, block_q), _fit_block(s, block_k)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -208,7 +280,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
-def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     """Pallas backward: dq via (head, q-tile) grid, dk/dv via a
     (kv-head, k-tile) grid that accumulates the GQA group in-kernel."""
     qt = jnp.transpose(q, (0, 2, 1, 3))
@@ -219,22 +291,23 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     b, h, s, d = qt.shape
     kv_heads = kt.shape[1]
     group = h // kv_heads
-    block_q, block_k = _blocks(s, block_q, block_k)
+    dq_bq, dq_bk = _blocks(s, DQ_BLOCK_Q, DQ_BLOCK_K)
+    dkv_bq, dkv_bk = _blocks(s, DKV_BLOCK_Q, DKV_BLOCK_K)
     scale = 1.0 / (d ** 0.5)
     # delta_i = sum_d dO_i . O_i  (rowwise), the softmax-normalization term.
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1,
                     keepdims=True)
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
     kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+    row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
                             lambda bi, hi, qi: (bi, hi, qi, 0))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
+        functools.partial(_dq_kernel, block_k=dq_bk, scale=scale,
                           causal=causal),
-        grid=(b, h, s // block_q),
+        grid=(b, h, s // dq_bq),
         in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
+        out_specs=pl.BlockSpec((1, 1, dq_bq, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
@@ -243,13 +316,13 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     # Grid over KV heads: block index maps pick up this head's group of G
     # query heads ((1, G, ...) blocks); dk/dv land at KV-head granularity —
     # no (B, H, S, D) expansion buffer.
-    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0))
+    kv_spec = pl.BlockSpec((1, 1, dkv_bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
     qgrp_spec = pl.BlockSpec((1, group, s, d), lambda bi, hi, ki: (bi, hi, 0, 0))
     rowgrp_spec = pl.BlockSpec((1, group, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
+        functools.partial(_dkv_kernel, block_q=dkv_bq, scale=scale,
                           causal=causal),
-        grid=(b, kv_heads, s // block_k),
+        grid=(b, kv_heads, s // dkv_bk),
         in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
                   rowgrp_spec],
         out_specs=[kv_spec, kv_spec],
@@ -272,21 +345,20 @@ def _interpret() -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal=True):
     """Causal flash attention; q (B,S,H,D), k/v (B,S,K,D) -> (B,S,H,D)."""
-    out, _ = _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+    out, _ = _flash_fwd(q, k, v, causal, FWD_BLOCK_Q, FWD_BLOCK_K,
                         _interpret())
     return out
 
 
 def _flash_attention_fwd(q, k, v, causal):
-    out, lse = _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+    out, lse = _flash_fwd(q, k, v, causal, FWD_BLOCK_Q, FWD_BLOCK_K,
                           _interpret())
     return out, (q, k, v, out, lse)
 
 
 def _flash_attention_bwd(causal, residuals, g):
     q, k, v, o, lse = residuals
-    return _flash_bwd(q, k, v, o, lse, g, causal, DEFAULT_BLOCK_Q,
-                      DEFAULT_BLOCK_K, _interpret())
+    return _flash_bwd(q, k, v, o, lse, g, causal, _interpret())
 
 
 flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

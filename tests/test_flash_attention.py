@@ -9,7 +9,15 @@ from fault_tolerant_llm_training_tpu.ops.attention import xla_attention
 from fault_tolerant_llm_training_tpu.ops.flash_attention import flash_attention
 
 
-@pytest.mark.parametrize("s,h,kv,d", [(256, 4, 4, 32), (512, 4, 2, 32)])
+@pytest.mark.parametrize("s,h,kv,d", [
+    (256, 4, 4, 32),
+    (512, 4, 2, 32),
+    # Full tuned operating point: exercises the fwd bq=1024 tail split and
+    # the dkv straddle logic with block_k=1024 > block_q=512 (multiple
+    # masked q-blocks per k-tile) — shapes smaller than the tuned blocks
+    # clamp them away and never hit these paths.
+    (2048, 2, 1, 32),
+])
 def test_flash_matches_reference(s, h, kv, d):
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((2, s, h, d)), jnp.float32)
@@ -24,6 +32,7 @@ def test_flash_matches_reference(s, h, kv, d):
 @pytest.mark.parametrize("s,h,kv,d", [
     (256, 2, 2, 32),   # single q/k block
     (512, 4, 2, 32),   # GQA group-sum + multi-block causal bounds
+    (2048, 2, 1, 32),  # tuned dq(512,512)/dkv(512,1024) causal splits
 ])
 def test_flash_gradients_match(s, h, kv, d):
     rng = np.random.default_rng(1)
