@@ -237,6 +237,13 @@ def _fit_block(s, block):
     for b in range(8, block + 1, 8):
         if s % b == 0:
             best = b
+    if best < block // 4 or best > block * 4:
+        import logging
+        logging.getLogger(__name__).warning(
+            "flash attention: seq len %d forces a %d-row tile far from the "
+            "tuned %d; expect degraded throughput (pad the sequence length "
+            "to a multiple of a large power of two to avoid this)",
+            s, best, block)
     return best
 
 

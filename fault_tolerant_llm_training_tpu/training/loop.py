@@ -82,6 +82,18 @@ class Trainer:
         self._sync_signals = jax.process_count() > 1
 
         self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp)
+        data_ways = (self.mesh.shape["data"] * self.mesh.shape["fsdp"])
+        if cfg.batch_size % data_ways:
+            raise ValueError(
+                f"--batch-size {cfg.batch_size} is not divisible by the "
+                f"data-sharding extent dp*fsdp = {data_ways} "
+                f"(mesh {dict(self.mesh.shape)}); pick a batch size that "
+                f"divides evenly or reduce --dp/--fsdp")
+        if cfg.sequence_length % self.mesh.shape["sequence"]:
+            raise ValueError(
+                f"--sequence-length {cfg.sequence_length} is not divisible "
+                f"by the sequence-parallel extent sp = "
+                f"{self.mesh.shape['sequence']}")
         self._mesh_ctx = use_mesh(self.mesh)
         self._mesh_ctx.__enter__()
 

@@ -52,9 +52,13 @@ def _args(tmp_path, parquet, **over):
     return argv
 
 
-def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None):
+def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None,
+         xla_devices=None):
     env = _env()
     env["SLURM_JOB_ID"] = job_id
+    if xla_devices is not None:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={xla_devices}")
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
     if send_signal is not None:
@@ -79,6 +83,13 @@ def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None):
 def _losses(out):
     return [line.split("Loss: ")[1].strip()
             for line in out.splitlines() if "| Loss: " in line]
+
+
+def _losses_by_step(out):
+    """step -> loss string, parsed from 'Training step: N | Loss: X' lines."""
+    return {line.split("|")[0].split(":")[-1].strip():
+            line.split("Loss: ")[1].strip()
+            for line in out.splitlines() if "| Loss: " in line}
 
 
 @pytest.fixture(scope="module")
@@ -129,11 +140,43 @@ def test_injected_error_saves_no_resubmit_then_bitexact_resume(tmp_path, parquet
     assert "Training completed" in out2
     # Bit-exact continuity: every post-resume loss equals the uninterrupted
     # run's loss at the same step.
-    resumed = {line.split("|")[0].split(":")[-1].strip(): line.split("Loss: ")[1].strip()
-               for line in out2.splitlines() if "| Loss: " in line}
-    for step_str, loss in resumed.items():
+    for step_str, loss in _losses_by_step(out2).items():
         step = int(step_str)
         assert base_losses[step] == loss, (step, base_losses[step], loss)
+
+
+def test_resume_on_different_topology(tmp_path, parquet):
+    """SURVEY.md §7.3 hard part 3: a checkpoint written on one topology must
+    resume on another with the same loss trajectory. Here: save on a single
+    device, resume on an 8-device dp=2 x fsdp=4 mesh. Losses are compared
+    numerically (cross-device psum order may differ in the last ulps, and
+    the log prints 2 decimals). Batch 8 so the batch axis divides the
+    resumed mesh's dp x fsdp = 8-way data sharding."""
+    rc, baseline = _run(_args(tmp_path / "base", parquet,
+                              **{"--batch-size": "8"}), job_id="tb0")
+    assert rc == 0
+    base_losses = _losses(baseline)
+
+    argv = _args(tmp_path, parquet, **{"--batch-size": "8",
+                                       "--raise-error": "",
+                                       "--error-step": "10"})
+    rc, out = _run(argv, job_id="tp1")
+    assert rc == 0, out
+    assert "Checkpoint saved at step" in out
+
+    argv = _args(tmp_path, parquet, **{"--batch-size": "8",
+                                       "--checkpoint-id": "tp1",
+                                       "--dp": "2", "--fsdp": "4"})
+    rc, out2 = _run(argv, job_id="tp2", xla_devices=8)
+    assert rc == 0, out2
+    assert "Resuming training from training_step" in out2
+    assert "Training completed" in out2
+    resumed = _losses_by_step(out2)
+    assert len(resumed) >= 10
+    for step_str, loss in resumed.items():
+        step = int(step_str)
+        assert abs(float(base_losses[step]) - float(loss)) <= 0.02, (
+            step, base_losses[step], loss)
 
 
 def test_usr1_saves_and_resubmits(tmp_path, parquet):
