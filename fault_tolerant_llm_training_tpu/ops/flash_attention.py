@@ -32,6 +32,19 @@ VPU economy (attention at head_dim 64 is VPU-bound on TPU, not MXU-bound):
 lse/delta carry a trailing singleton dim — (B, H, S, 1) — because the Pallas
 TPU lowering requires a block's last two dims to be (8k, 128m)-tileable or
 full; (block_q, 1) satisfies that where rank-3 (1, 1, block_q) does not.
+
+Two kernel families, dispatched on sequence length:
+
+- **Resident** (S <= STREAM_THRESHOLD): the non-grid operand (K/V for
+  fwd/dq, the q/do rows for dk/dv) sits whole in VMEM and an in-kernel
+  fori_loop walks it. Fastest at moderate S — no per-block pipeline
+  boundaries — but VMEM-bound: the resident rows grow linearly with S.
+- **Streaming** (S > STREAM_THRESHOLD): the loop moves into the grid's
+  innermost dimension; the online-softmax / gradient accumulators live in
+  VMEM scratch that persists across grid steps, and every operand is a
+  fixed-size tile. O(1) VMEM in S — this is what makes 32k+ contexts
+  compile on a single chip (beyond that, ring attention shards S over the
+  mesh's 'sequence' axis, ops/ring_attention.py).
 """
 
 import functools
@@ -40,6 +53,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # Tile sizes tuned on TPU v5e at S=2048, D=64 (see BASELINE.md); each kernel
 # has its own operating point because the blocks play different roles: the
@@ -47,6 +61,10 @@ from jax.experimental import pallas as pl
 FWD_BLOCK_Q, FWD_BLOCK_K = 1024, 256
 DQ_BLOCK_Q, DQ_BLOCK_K = 512, 512
 DKV_BLOCK_Q, DKV_BLOCK_K = 512, 1024
+# Above this sequence length the resident kernels' full-row VMEM operands no
+# longer fit (empirically the dk/dv kernel is first to die, ~8k at D=64);
+# switch to the streaming kernels.
+STREAM_THRESHOLD = 4096
 NEG_INF = -1e30
 LOG2E = math.log2(math.e)
 LN2 = math.log(2.0)
@@ -62,21 +80,30 @@ def _prescale_q(q_ref_slice, scale):
         q_ref_slice.dtype)
 
 
+def _causal_select(s, q_start, k_start):
+    """Apply the causal mask to a (bq, bk) score tile in place."""
+    bq, bk = s.shape
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 def _scores(q2, k, q_start, k_start, masked):
     """q2 @ k^T base-2 scores (fp32); q2 is pre-scaled by scale*log2(e).
 
-    Applies the causal select only when ``masked`` (diagonal blocks).
+    Applies the causal select only when ``masked``: statically elided for
+    full blocks when ``masked`` is a Python bool (resident kernels), or a
+    runtime lax.cond when it is a traced predicate (streaming kernels,
+    where the diagonal/full distinction is a grid position).
     q2: (bq, D), k: (bk, D) -> (bq, bk).
     """
     s = jax.lax.dot_general(
         q2, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    if masked:
-        bq, bk = s.shape
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    return s
+    if isinstance(masked, bool):
+        return _causal_select(s, q_start, k_start) if masked else s
+    return jax.lax.cond(
+        masked, lambda x: _causal_select(x, q_start, k_start), lambda x: x, s)
 
 
 def _k_block_bounds(q_start, block_q, s_k, block_k, causal):
@@ -222,6 +249,151 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
+def _stream_bounds(ki, q_start, block_q, n_k, block_k, causal):
+    """(useful, masked, n_total) for streamed k-step ``ki`` of a q-tile.
+
+    Single source of truth for the causal grid bounds shared by the fwd and
+    dq streaming kernels (the dkv kernel streams the transposed geometry and
+    has its own bounds).
+    """
+    if not causal:
+        return True, False, n_k
+    n_full, n_total = _k_block_bounds(q_start, block_q, n_k * block_k,
+                                      block_k, causal)
+    return ki < n_total, ki >= n_full, n_total
+
+
+def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr, *, block_q: int, block_k: int,
+                       scale: float, causal: bool):
+    # grid (b, h, qi, ki), ki innermost/sequential. q_ref/o_ref:
+    # (1, 1, block_q, D) at qi; k_ref/v_ref: (1, 1, block_k, D) at ki;
+    # lse_ref: (1, 1, block_q, 1). Scratch (fp32, persists across ki):
+    # m/l (block_q, 1), acc (block_q, D).
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_start = pl.program_id(2) * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    useful, masked, n_total = _stream_bounds(ki, q_start, block_q, n_k,
+                                             block_k, causal)
+
+    @pl.when(useful)
+    def _step():
+        q2 = _prescale_q(q_ref[0, 0], scale)
+        s = _scores(q2, k_ref[0, 0], q_start, k_start, masked)
+        m_prev = m_scr[...][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m_prev - m_new)
+        l_scr[...] = (l_scr[...][:, 0] * alpha + jnp.sum(p, axis=-1))[:, None]
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new[:, None]
+
+    @pl.when(ki == n_total - 1)
+    def _emit():
+        l = l_scr[...][:, 0]
+        o_ref[0, 0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log2(l)[:, None]
+
+
+def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, block_q: int, block_k: int,
+                      scale: float, causal: bool):
+    # grid (b, h, qi, ki), ki innermost. Same tiling as _fwd_stream_kernel
+    # plus do/delta at qi; scratch dq (block_q, D) fp32.
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_start = pl.program_id(2) * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    useful, masked, n_total = _stream_bounds(ki, q_start, block_q, n_k,
+                                             block_k, causal)
+
+    @pl.when(useful)
+    def _step():
+        q2 = _prescale_q(q_ref[0, 0], scale)
+        k = k_ref[0, 0]
+        s = _scores(q2, k, q_start, k_start, masked)
+        p = jnp.exp2(s - lse_ref[0, 0])
+        dp = jax.lax.dot_general(
+            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0])
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_total - 1)
+    def _emit():
+        dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+                       block_k: int, scale: float, causal: bool):
+    # grid (b, kv_head, ki, qi), qi innermost. k/v/dk/dv: (1, 1, block_k, D)
+    # at ki; q/do: (1, G, block_q, D) at qi; lse/delta: (1, G, block_q, 1).
+    # Scratch dk/dv (block_k, D) fp32, persists across qi.
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    k_start = pl.program_id(2) * block_k
+    q_start = qi * block_q
+    group = q_ref.shape[1]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        j_start = k_start // block_q
+        j_full = (k_start + block_k - 1 + block_q - 1) // block_q
+        useful = qi >= j_start
+        masked = qi < j_full
+    else:
+        useful, masked = True, False
+
+    @pl.when(useful)
+    def _step():
+        dk_acc, dv_acc = dk_scr[...], dv_scr[...]
+        for g in range(group):  # static loop: accumulate the GQA group
+            q2 = _prescale_q(q_ref[0, g], scale)
+            do = do_ref[0, g]
+            s = _scores(q2, k, q_start, k_start, masked)
+            p = jnp.exp2(s - lse_ref[0, g])
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_ref[0, g])
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dk_scr[...], dv_scr[...] = dk_acc, dv_acc
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = (dk_scr[...] * LN2).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _fit_block(s, block):
     """Largest usable tile size <= ``block`` for a sequence of length ``s``.
 
@@ -261,29 +433,62 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     group = h // kv_heads
     block_q, block_k = _blocks(s, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
+    out_shape = [
+        jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, *_: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi, *_: (bi, hi, qi, 0)),
+    ]
 
-    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
-                               causal=causal)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(b, h, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qt, kt, vt)
+    if s <= STREAM_THRESHOLD:
+        kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
+                                   causal=causal)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(b, h, s // block_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda bi, hi, qi: (bi, hi, qi, 0)),
+                pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+                pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(qt, kt, vt)
+    else:
+        kernel = functools.partial(_fwd_stream_kernel, block_q=block_q,
+                                   block_k=block_k, scale=scale, causal=causal)
+        # Causal: grid steps past the diagonal are no-ops in the kernel, so
+        # clamp their K/V block index to the last useful one — an unchanged
+        # index makes the pipeline skip the HBM fetch entirely.
+        if causal:
+            def kv_idx(bi, hi, qi, ki):
+                last = (qi * block_q + block_q - 1) // block_k
+                return (bi, hi // group, jnp.minimum(ki, last), 0)
+        else:
+            def kv_idx(bi, hi, qi, ki):
+                return (bi, hi // group, ki, 0)
+        kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_idx)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(b, h, s // block_q, s // block_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+                kv_spec, kv_spec,
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qt, kt, vt)
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
@@ -305,40 +510,92 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1,
                     keepdims=True)
 
-    q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
-    kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
-    row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
-                            lambda bi, hi, qi: (bi, hi, qi, 0))
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=dq_bk, scale=scale,
-                          causal=causal),
-        grid=(b, h, s // dq_bq),
-        in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
-        out_specs=pl.BlockSpec((1, 1, dq_bq, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-        interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    if s <= STREAM_THRESHOLD:
+        q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+        kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
+        row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
+                                lambda bi, hi, qi: (bi, hi, qi, 0))
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, block_k=dq_bk, scale=scale,
+                              causal=causal),
+            grid=(b, h, s // dq_bq),
+            in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
+            out_specs=pl.BlockSpec((1, 1, dq_bq, d),
+                                   lambda bi, hi, qi: (bi, hi, qi, 0)),
+            out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, delta)
+    else:
+        q_spec = pl.BlockSpec((1, 1, dq_bq, d),
+                              lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        if causal:  # same fetch-elision clamp as the fwd streaming kernel
+            def dq_kv_idx(bi, hi, qi, ki):
+                last = (qi * dq_bq + dq_bq - 1) // dq_bk
+                return (bi, hi // group, jnp.minimum(ki, last), 0)
+        else:
+            def dq_kv_idx(bi, hi, qi, ki):
+                return (bi, hi // group, ki, 0)
+        kv_spec = pl.BlockSpec((1, 1, dq_bk, d), dq_kv_idx)
+        row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
+                                lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        dq = pl.pallas_call(
+            functools.partial(_dq_stream_kernel, block_q=dq_bq, block_k=dq_bk,
+                              scale=scale, causal=causal),
+            grid=(b, h, s // dq_bq, s // dq_bk),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=pl.BlockSpec((1, 1, dq_bq, d),
+                                   lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            scratch_shapes=[pltpu.VMEM((dq_bq, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, delta)
 
     # Grid over KV heads: block index maps pick up this head's group of G
     # query heads ((1, G, ...) blocks); dk/dv land at KV-head granularity —
     # no (B, H, S, D) expansion buffer.
-    kv_spec = pl.BlockSpec((1, 1, dkv_bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
-    qgrp_spec = pl.BlockSpec((1, group, s, d), lambda bi, hi, ki: (bi, hi, 0, 0))
-    rowgrp_spec = pl.BlockSpec((1, group, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=dkv_bq, scale=scale,
-                          causal=causal),
-        grid=(b, kv_heads, s // dkv_bk),
-        in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                  rowgrp_spec],
-        out_specs=[kv_spec, kv_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct(kt.shape, k.dtype),
-            jax.ShapeDtypeStruct(vt.shape, v.dtype),
-        ],
-        interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    if s <= STREAM_THRESHOLD:
+        kv_spec = pl.BlockSpec((1, 1, dkv_bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
+        qgrp_spec = pl.BlockSpec((1, group, s, d), lambda bi, hi, ki: (bi, hi, 0, 0))
+        rowgrp_spec = pl.BlockSpec((1, group, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0))
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, block_q=dkv_bq, scale=scale,
+                              causal=causal),
+            grid=(b, kv_heads, s // dkv_bk),
+            in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
+                      rowgrp_spec],
+            out_specs=[kv_spec, kv_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            ],
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, delta)
+    else:
+        kv_spec = pl.BlockSpec((1, 1, dkv_bk, d),
+                               lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+        if causal:  # steps before the diagonal are no-ops: pin their q fetch
+            def dkv_q_idx(bi, hi, ki, qi):
+                return (bi, hi, jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
+        else:
+            def dkv_q_idx(bi, hi, ki, qi):
+                return (bi, hi, qi, 0)
+        qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
+        rowgrp_spec = pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx)
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
+                              block_k=dkv_bk, scale=scale, causal=causal),
+            grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
+            in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
+                      rowgrp_spec],
+            out_specs=[kv_spec, kv_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
+                            pltpu.VMEM((dkv_bk, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, delta)
     dq_out = jnp.transpose(dq, (0, 2, 1, 3))
     dk_out = jnp.transpose(dk, (0, 2, 1, 3))
     dv_out = jnp.transpose(dv, (0, 2, 1, 3))

@@ -35,6 +35,28 @@ def test_flash_matches_reference(s, h, kv, d):
     (2048, 2, 1, 32),  # tuned dq(512,512)/dkv(512,1024) causal splits
 ])
 def test_flash_gradients_match(s, h, kv, d):
+    _check_gradients(s, h, kv, d)
+
+
+@pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32)])
+def test_streaming_kernels_match(s, h, kv, d, monkeypatch):
+    """The long-context streaming kernels (grid-streamed loop operand +
+    scratch accumulators; selected above STREAM_THRESHOLD) must agree with
+    the XLA reference. Forced on at small S so CI covers them cheaply."""
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+    monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
+    want = xla_attention(q, k, v, causal=True)
+    got = fa.flash_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    _check_gradients(s, h, kv, d)  # monkeypatch still active: streaming path
+
+
+def _check_gradients(s, h, kv, d):
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.standard_normal((1, s, h, d)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
