@@ -62,9 +62,9 @@ FWD_BLOCK_Q, FWD_BLOCK_K = 1024, 256
 DQ_BLOCK_Q, DQ_BLOCK_K = 512, 512
 DKV_BLOCK_Q, DKV_BLOCK_K = 512, 1024
 # Above this sequence length the resident kernels' full-row VMEM operands no
-# longer fit (empirically the dk/dv kernel is first to die, ~8k at D=64);
-# switch to the streaming kernels.
-STREAM_THRESHOLD = 4096
+# longer fit (empirically the dk/dv kernel is first to die: 18.4M scoped vmem
+# vs the 16M limit at S=4096, D=64); switch to the streaming kernels.
+STREAM_THRESHOLD = 2048
 NEG_INF = -1e30
 LOG2E = math.log2(math.e)
 LN2 = math.log(2.0)
@@ -106,6 +106,57 @@ def _scores(q2, k, q_start, k_start, masked):
         masked, lambda x: _causal_select(x, q_start, k_start), lambda x: x, s)
 
 
+def _online_softmax_step(q2, k, v, carry, q_start, k_start, masked):
+    """One online-softmax accumulation over a (bq, bk) tile.
+
+    carry = (m, l, acc) running rowwise max (base-2), normalizer, and fp32
+    PV accumulator. Shared by the resident and streaming forward kernels so
+    their math can never diverge."""
+    m_prev, l_prev, acc_prev = carry
+    s = _scores(q2, k, q_start, k_start, masked)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp2(s - m_new[:, None])
+    alpha = jnp.exp2(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc_prev * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _dq_tile(q2, k, v, do, lse, delta, q_start, k_start, masked):
+    """Unscaled dq contribution of one (bq, bk) tile (caller scales once)."""
+    s = _scores(q2, k, q_start, k_start, masked)
+    p = jnp.exp2(s - lse)  # exact probabilities; lse is (bq, 1), base-2
+    dp = jax.lax.dot_general(  # dO @ V^T: (bq, bk)
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    return jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _dkv_tile(q2, k, v, do, lse, delta, q_start, k_start, masked):
+    """(dk, dv) contributions of one (bq, bk) tile for one GQA query head.
+
+    dk is unscaled: dk_true = (ds*scale)^T @ q_raw = (ds^T @ q2) * ln(2)
+    since q2 = q_raw * scale * log2(e); the caller rescales once."""
+    s = _scores(q2, k, q_start, k_start, masked)
+    p = jnp.exp2(s - lse)
+    dv_c = jax.lax.dot_general(  # P^T @ dO
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(  # dO @ V^T
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_c = jax.lax.dot_general(  # dS^T @ Q2
+        ds.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return dk_c, dv_c
+
+
 def _k_block_bounds(q_start, block_q, s_k, block_k, causal):
     """(n_full, n_total) k-block counts for a q-tile at ``q_start``.
 
@@ -134,19 +185,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     n_full, n_total = _k_block_bounds(q_start, block_q, s_k, block_k, causal)
 
     def body(j, carry, masked):
-        m_prev, l_prev, acc_prev = carry
         k_start = j * block_k
         k = k_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = _scores(q2, k, q_start, k_start, masked)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp2(s - m_new[:, None])
-        alpha = jnp.exp2(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
-        acc_new = acc_prev * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        return _online_softmax_step(q2, k, v, carry, q_start, k_start, masked)
 
     init = (jnp.full((block_q,), NEG_INF, jnp.float32),
             jnp.zeros((block_q,), jnp.float32),
@@ -176,15 +218,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         k_start = j * block_k
         k = k_ref[0, 0, pl.ds(k_start, block_k), :]
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = _scores(q2, k, q_start, k_start, masked)
-        p = jnp.exp2(s - lse)  # exact probabilities; lse is (block_q, 1)
-        dp = jax.lax.dot_general(  # dO @ V^T: (block_q, block_k)
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)  # unscaled; dq rescaled once at the write
-        return dq_acc + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        return dq_acc + _dq_tile(q2, k, v, do, lse, delta, q_start, k_start,
+                                 masked)
 
     dq = jax.lax.fori_loop(0, n_full, functools.partial(body, masked=False),
                            jnp.zeros((block_q, d), jnp.float32))
@@ -222,20 +257,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do = do_ref[0, g, pl.ds(q_start, block_q), :]
             lse = lse_ref[0, g, pl.ds(q_start, block_q), :]
             delta = delta_ref[0, g, pl.ds(q_start, block_q), :]
-            s = _scores(q2, k, q_start, k_start, masked)
-            p = jnp.exp2(s - lse)  # lse is (block_q, 1)
-            dv_acc = dv_acc + jax.lax.dot_general(  # P^T @ dO
-                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            dp = jax.lax.dot_general(  # dO @ V^T
-                do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            ds = p * (dp - delta)
-            # dk (true) = (ds*scale)^T @ q_raw = ds^T @ q2 * ln(2), since
-            # q2 = q_raw * scale * log2(e); rescaled once at the write.
-            dk_acc = dk_acc + jax.lax.dot_general(
-                ds.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            dk_c, dv_c = _dkv_tile(q2, k, v, do, lse, delta, q_start,
+                                   k_start, masked)
+            dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
         return dk_acc, dv_acc
 
     init = (jnp.zeros((block_k, d), jnp.float32),
@@ -287,16 +311,12 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(useful)
     def _step():
         q2 = _prescale_q(q_ref[0, 0], scale)
-        s = _scores(q2, k_ref[0, 0], q_start, k_start, masked)
-        m_prev = m_scr[...][:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp2(s - m_new[:, None])
-        alpha = jnp.exp2(m_prev - m_new)
-        l_scr[...] = (l_scr[...][:, 0] * alpha + jnp.sum(p, axis=-1))[:, None]
-        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[...] = m_new[:, None]
+        carry = (m_scr[...][:, 0], l_scr[...][:, 0], acc_scr[...])
+        m, l, acc = _online_softmax_step(q2, k_ref[0, 0], v_ref[0, 0], carry,
+                                         q_start, k_start, masked)
+        m_scr[...] = m[:, None]
+        l_scr[...] = l[:, None]
+        acc_scr[...] = acc
 
     @pl.when(ki == n_total - 1)
     def _emit():
@@ -325,16 +345,9 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(useful)
     def _step():
         q2 = _prescale_q(q_ref[0, 0], scale)
-        k = k_ref[0, 0]
-        s = _scores(q2, k, q_start, k_start, masked)
-        p = jnp.exp2(s - lse_ref[0, 0])
-        dp = jax.lax.dot_general(
-            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0])
-        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + _dq_tile(
+            q2, k_ref[0, 0], v_ref[0, 0], do_ref[0, 0], lse_ref[0, 0],
+            delta_ref[0, 0], q_start, k_start, masked)
 
     @pl.when(ki == n_total - 1)
     def _emit():
@@ -373,19 +386,9 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = dk_scr[...], dv_scr[...]
         for g in range(group):  # static loop: accumulate the GQA group
             q2 = _prescale_q(q_ref[0, g], scale)
-            do = do_ref[0, g]
-            s = _scores(q2, k, q_start, k_start, masked)
-            p = jnp.exp2(s - lse_ref[0, g])
-            dv_acc = dv_acc + jax.lax.dot_general(
-                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            ds = p * (dp - delta_ref[0, g])
-            dk_acc = dk_acc + jax.lax.dot_general(
-                ds.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            dk_c, dv_c = _dkv_tile(q2, k, v, do_ref[0, g], lse_ref[0, g],
+                                   delta_ref[0, g], q_start, k_start, masked)
+            dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
         dk_scr[...], dv_scr[...] = dk_acc, dv_acc
 
     @pl.when(qi == n_q - 1)

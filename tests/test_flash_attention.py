@@ -38,22 +38,30 @@ def test_flash_gradients_match(s, h, kv, d):
     _check_gradients(s, h, kv, d)
 
 
+@pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32)])
-def test_streaming_kernels_match(s, h, kv, d, monkeypatch):
+def test_streaming_kernels_match(s, h, kv, d, causal, monkeypatch):
     """The long-context streaming kernels (grid-streamed loop operand +
     scratch accumulators; selected above STREAM_THRESHOLD) must agree with
-    the XLA reference. Forced on at small S so CI covers them cheaply."""
+    the XLA reference, causal and non-causal (the non-causal branch has its
+    own index maps and bounds). Forced on at small S so CI covers them."""
     import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
     monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((2, s, h, d)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
-    want = xla_attention(q, k, v, causal=True)
-    got = fa.flash_attention(q, k, v, True)
+    want = xla_attention(q, k, v, causal=causal)
+    got = fa.flash_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
-    _check_gradients(s, h, kv, d)  # monkeypatch still active: streaming path
+    g_ref = jax.grad(lambda *a: jnp.sum(xla_attention(*a, causal=causal) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(lambda *a: jnp.sum(fa.flash_attention(*a, causal) ** 2),
+                       argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
 
 
 def _check_gradients(s, h, kv, d):
