@@ -256,7 +256,10 @@ class Trainer:
             self.state, metrics = self._compiled_step(self.state, inputs,
                                                       labels)
             self._last_data_state = data_state
-            inflight.append((self.training_step, metrics))
+            # The jitted step pre-packs (loss, grad_norm) into one array so
+            # _consume pays ONE host round trip per step, not one per metric
+            # (each fetch is a full RPC on tunneled device transports).
+            inflight.append((self.training_step, metrics["packed"]))
             while len(inflight) >= max(1, cfg.inflight):
                 self._consume(*inflight.popleft())
             # Deterministic fault injection (ref: train.py:112-113): raised
@@ -274,10 +277,12 @@ class Trainer:
         while inflight:
             self._consume(*inflight.popleft())
 
-    def _consume(self, step_no: int, metrics: dict) -> None:
-        """Pull one step's metrics to the host (the only D2H sync point —
-        the reference syncs via loss.item() at train.py:116)."""
-        grad_norm = float(metrics["grad_norm"])
+    def _consume(self, step_no: int, packed: jnp.ndarray) -> None:
+        """Pull one step's packed (loss, grad_norm) to the host — the only
+        D2H sync point (the reference syncs via loss.item() at
+        train.py:116), and a single transfer."""
+        vals = np.asarray(packed)
+        loss, grad_norm = float(vals[0]), float(vals[1])
         if not math.isfinite(grad_norm):
             # ref: utils.py:61 error_if_nonfinite -> routed as code error (-1)
             # grad_norm is a replicated global value: every host raises here
@@ -285,7 +290,7 @@ class Trainer:
             raise NonFiniteGradientError(
                 f"non-finite gradient norm {grad_norm} at step {step_no}")
         self.throughput.step()
-        self.last_loss = float(metrics["loss"])
+        self.last_loss = loss
         if step_no == 1 or step_no % self.cfg.logging_frequency == 0:
             # ref: train.py:115-116 (exact format), plus throughput extras
             logger.info(AUDIT_STEP_FMT.format(step=step_no,

@@ -75,7 +75,11 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt_state)
         metrics = {"loss": loss, "grad_norm": grad_norm,
-                   "num_tokens": num_tokens}
+                   "num_tokens": num_tokens,
+                   # (loss, grad_norm) as one array: the host loop fetches
+                   # this single leaf per step — one D2H RPC on tunneled
+                   # transports instead of one per scalar (training/loop.py).
+                   "packed": jnp.stack((loss, grad_norm))}
         return new_state, metrics
 
     return train_step
