@@ -58,7 +58,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
     metrics: loss (fp32), grad_norm (fp32; host checks finiteness — the
     torch ``error_if_nonfinite`` raise cannot live inside jit, ref:
-    utils.py:61), num_tokens, lr.
+    utils.py:61), num_tokens, and packed = stack((loss, grad_norm)) — the
+    single leaf the host loop fetches per step (one D2H transfer).
     """
 
     def loss_fn(params, inputs, labels):

@@ -1,0 +1,118 @@
+"""Profile the training step and print a per-op-category device-time table.
+
+The reference has no profiling subsystem (SURVEY.md §5.1); here
+``jax.profiler`` traces are first-class: ``train.py --profile-dir`` records
+one, and this tool both records and *reads* them — it parses the Chrome-trace
+JSON the TPU runtime emits and aggregates device time by op family, which is
+how the kernel/copy/fusion breakdown in BASELINE.md was measured.
+
+Usage:
+    python scripts/profile_step.py [--model gpt2-125m] [--batch-size 8]
+        [--sequence-length 2048] [--steps 3] [--trace-dir /tmp/ftl_trace]
+
+Works on any backend; on CPU the "device" is the host and times are
+illustrative only.
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_trace(trace_dir: str, steps: int):
+    """Aggregate device-side op durations from the newest trace in
+    ``trace_dir``. Returns (per-category ms/step dict, total ms/step)."""
+    files = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    with gzip.open(files[-1]) as fh:
+        data = json.load(fh)
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in data["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    cat = collections.Counter()
+    for e in data["traceEvents"]:
+        if e.get("ph") != "X":
+            continue
+        pname = pids.get(e["pid"], "")
+        if "TPU" not in pname and "device" not in pname.lower():
+            continue
+        n = e["name"]
+        # skip the whole-program span and the per-execution lane aggregates
+        if n.startswith("jit_") or n.isdigit():
+            continue
+        cat[re.sub(r"\.\d+$", "", n)] += e.get("dur", 0)
+    total = sum(cat.values())
+    return ({k: v / steps / 1000 for k, v in cat.items()},
+            total / steps / 1000)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="gpt2-125m")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--sequence-length", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--trace-dir", default="/tmp/ftl_trace")
+    p.add_argument("--top", type=int, default=15)
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+    from fault_tolerant_llm_training_tpu.training.state import TrainState
+    from fault_tolerant_llm_training_tpu.training.step import (
+        make_optimizer,
+        make_train_step,
+    )
+    from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
+
+    cfg = get_config(args.model, seq_len=args.sequence_length,
+                     **({} if get_config(args.model).vocab_size > 0
+                        else {"vocab_size": 50257}))
+    model = Transformer(cfg)
+    opt = make_optimizer(3e-4, warmup_steps=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt.init(params))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(
+        0, cfg.vocab_size, (args.batch_size, cfg.seq_len)).astype(np.int32))
+    labels = jnp.concatenate(
+        [toks[:, 1:], jnp.full((args.batch_size, 1), -100, jnp.int32)], axis=1)
+    step = jax.jit(make_train_step(model, opt, 1.0), donate_argnums=(0,))
+    state, m = step(state, toks, labels)  # compile outside the trace
+    hard_sync(m)
+
+    jax.profiler.start_trace(args.trace_dir)
+    for _ in range(args.steps):
+        state, m = step(state, toks, labels)
+    hard_sync(m)
+    jax.profiler.stop_trace()
+
+    cats, total = parse_trace(args.trace_dir, args.steps)
+    print(f"\ndevice time by op family ({args.model}, "
+          f"bs {args.batch_size}, seq {cfg.seq_len}, "
+          f"backend {jax.default_backend()}):")
+    if not cats:
+        print("  (no device-lane events in trace — CPU backends emit "
+              "host-side traces only; run on TPU for the breakdown)")
+        return
+    print(f"{'ms/step':>10}  {'%':>5}  op family")
+    for name, ms in sorted(cats.items(), key=lambda kv: -kv[1])[:args.top]:
+        print(f"{ms:>10.2f}  {100 * ms / total:>5.1f}  {name}")
+    print(f"{total:>10.2f}  100.0  TOTAL (device-busy)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""scripts/profile_step.py trace parsing (hermetic: synthetic trace file)."""
+
+import gzip
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "profile_step", REPO / "scripts" / "profile_step.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["profile_step"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parse_trace_aggregates_device_ops(tmp_path):
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "pid": 3, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "/host:CPU"}},
+            # device ops: two fusions (same family), one pallas call, the
+            # whole-program span and a lane aggregate (both skipped)
+            {"ph": "X", "pid": 3, "name": "fusion.12", "dur": 3000},
+            {"ph": "X", "pid": 3, "name": "fusion.7", "dur": 1000},
+            {"ph": "X", "pid": 3, "name": "attention.4", "dur": 2000},
+            {"ph": "X", "pid": 3, "name": "jit_train_step(123)", "dur": 9999},
+            {"ph": "X", "pid": 3, "name": "1", "dur": 8888},
+            # host-side op: must be ignored
+            {"ph": "X", "pid": 9, "name": "fusion.99", "dur": 7777},
+        ]
+    }
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as fh:
+        json.dump(trace, fh)
+
+    tool = _load_tool()
+    cats, total = tool.parse_trace(str(tmp_path), steps=2)
+    # durations are us over 2 steps -> ms/step
+    assert cats == {"fusion": 2.0, "attention": 1.0}
+    assert total == 3.0
+
+
+def test_parse_trace_missing_dir_raises(tmp_path):
+    tool = _load_tool()
+    try:
+        tool.parse_trace(str(tmp_path / "nope"), steps=1)
+    except FileNotFoundError:
+        return
+    raise AssertionError("expected FileNotFoundError")
