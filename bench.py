@@ -21,19 +21,14 @@ REFERENCE_TOKENS_PER_SEC = 6380.0  # BASELINE.md throughput row
 
 def main():
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
-    from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+    from fault_tolerant_llm_training_tpu.models import get_config
     from fault_tolerant_llm_training_tpu.parallel.mesh import make_mesh, use_mesh
-    from fault_tolerant_llm_training_tpu.parallel.sharding import (
-        batch_pspec,
-        param_pspecs,
-    )
-    from fault_tolerant_llm_training_tpu.training.state import TrainState
-    from fault_tolerant_llm_training_tpu.training.step import (
-        make_optimizer,
-        make_train_step,
+    from fault_tolerant_llm_training_tpu.parallel.sharding import batch_pspec
+    from fault_tolerant_llm_training_tpu.utils.harness import (
+        synthetic_batch,
+        synthetic_state_and_step,
     )
     from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
 
@@ -49,30 +44,9 @@ def main():
     n_chips = len(mesh.devices.flatten())
 
     with use_mesh(mesh):
-        model = Transformer(cfg)
-        opt = make_optimizer(3e-4, warmup_steps=10)
-
-        def init_fn(key):
-            params = model.init(key, jnp.zeros((1, seq), jnp.int32))["params"]
-            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=opt.init(params))
-
-        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-        specs = param_pspecs(abstract)
-        shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
-        state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
-        step_fn = jax.jit(make_train_step(model, opt, 1.0),
-                          donate_argnums=(0,),
-                          out_shardings=(shardings, None))
-
-        rng = np.random.default_rng(0)
-        bsh = NamedSharding(mesh, batch_pspec())
-        toks = jax.device_put(
-            rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32), bsh)
-        labels = jnp.concatenate(
-            [toks[:, 1:], jnp.full((batch, 1), -100, jnp.int32)], axis=1)
+        state, step_fn = synthetic_state_and_step(cfg, mesh=mesh)
+        toks, labels = synthetic_batch(
+            cfg, batch, sharding=NamedSharding(mesh, batch_pspec()))
 
         # hard_sync: block_until_ready alone does not wait for execution on
         # the tunneled TPU backend (utils/sync.py), so timing anchors on a

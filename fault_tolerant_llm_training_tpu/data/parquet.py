@@ -21,7 +21,10 @@ both for behavioral parity; ``legacy=False`` keeps the leftover buffer and
 advances monotonically.
 """
 
-from typing import Dict, Tuple
+import bisect
+import glob
+import os
+from typing import Dict, List, Tuple
 
 import numpy as np
 import pyarrow.parquet as pq
@@ -30,18 +33,48 @@ from .native import pack_clm
 
 
 class _ParquetText:
-    """Memory-mapped 'text' column access (ref: dataset.py:18,28)."""
+    """Memory-mapped 'text' column access (ref: dataset.py:18,28), extended
+    to sharded datasets: ``path`` may be one file, a directory of
+    ``*.parquet`` shards, or a glob pattern. Shards are ordered
+    lexicographically and indexed as one logical table, so the datasets'
+    checkpointable positions (a single global index) are shard-layout
+    agnostic — the reference reads exactly one file (dataset.py:18)."""
 
-    def __init__(self, parquet_file: str):
-        self.table = pq.read_table(parquet_file, memory_map=True)
-        self.real_length = len(self.table)
-        self._column = self.table["text"]
+    def __init__(self, path: str):
+        files = self._resolve(path)
+        self._columns = []
+        self._offsets: List[int] = []  # start row of each shard
+        total = 0
+        for f in files:
+            table = pq.read_table(f, memory_map=True)
+            self._offsets.append(total)
+            self._columns.append(table["text"])
+            total += len(table)
+        self.real_length = total
+        if total == 0:
+            raise ValueError(f"parquet source {path!r} has no rows")
+
+    @staticmethod
+    def _resolve(path: str) -> List[str]:
+        if os.path.isdir(path):
+            files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+        elif any(c in path for c in "*?["):
+            files = sorted(glob.glob(path))
+            if not files and os.path.exists(path):
+                files = [path]  # a literal file name that merely looks globby
+        else:
+            files = [path]
+        if not files:
+            raise FileNotFoundError(f"no parquet shards match {path!r}")
+        return files
 
     def __len__(self) -> int:
         return self.real_length
 
     def text(self, idx: int) -> str:
-        return str(self._column[idx % self.real_length])
+        idx %= self.real_length
+        shard = bisect.bisect_right(self._offsets, idx) - 1
+        return str(self._columns[shard][idx - self._offsets[shard]])
 
 
 class ParquetDataset:

@@ -76,7 +76,8 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
         "--dataset",
         type=str,
         default=os.path.join(WORKDIR, "data", "train_data.parquet") if WORKDIR else "",
-        help="Path to a parquet file containing a 'text' column with documents (str)",
+        help="Parquet source with a 'text' column: one file, a directory "
+             "of *.parquet shards, or a glob pattern",
     )
     parser.add_argument(
         "--checkpoint-path",

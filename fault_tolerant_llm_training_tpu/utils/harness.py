@@ -1,0 +1,69 @@
+"""Shared synthetic-workload harness for bench.py and scripts/profile_step.py.
+
+One definition of "a training step on random data" so the benchmark and the
+profiler measure the identical workload: same TrainState construction, same
+optimizer, same label convention (shift-by-one with a -100 tail, matching
+CollatorForCLM / ref dataset.py:44-53).
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models import Transformer
+from ..training.state import TrainState
+from ..training.step import make_optimizer, make_train_step
+
+
+def synthetic_state_and_step(cfg, mesh=None, learning_rate: float = 3e-4,
+                             warmup_steps: int = 10,
+                             grad_max_norm: float = 1.0):
+    """Build (state, jitted step_fn) for ``cfg``.
+
+    With ``mesh``, params/optimizer are laid out by the path-rule shardings
+    (parallel/sharding.py) and the state argument is donated; without, a
+    plain single-device jit.
+    """
+    model = Transformer(cfg)
+    opt = make_optimizer(learning_rate, warmup_steps=warmup_steps)
+
+    def init_fn(key):
+        params = model.init(key, jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt.init(params))
+
+    step = make_train_step(model, opt, grad_max_norm)
+    if mesh is None:
+        state = jax.jit(init_fn)(jax.random.PRNGKey(0))
+        return state, jax.jit(step, donate_argnums=(0,))
+
+    from jax.sharding import NamedSharding
+    from ..parallel.sharding import param_pspecs
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    specs = param_pspecs(abstract)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+    step_fn = jax.jit(step, donate_argnums=(0,),
+                      out_shardings=(shardings, None))
+    return state, step_fn
+
+
+def synthetic_batch(cfg, batch: int, seed: int = 0,
+                    sharding=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Random (toks, labels) CLM batch; labels shift by one with a -100
+    tail (the collator's convention, ref dataset.py:47-53)."""
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size,
+                        (batch, cfg.seq_len)).astype(np.int32)
+    if sharding is not None:
+        toks = jax.device_put(toks, sharding)
+    else:
+        toks = jnp.asarray(toks)
+    labels = jnp.concatenate(
+        [toks[:, 1:], jnp.full((batch, 1), -100, jnp.int32)], axis=1)
+    return toks, labels

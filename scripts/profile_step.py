@@ -64,33 +64,18 @@ def main():
     p.add_argument("--top", type=int, default=15)
     args = p.parse_args()
 
-    import numpy as np
     import jax
-    import jax.numpy as jnp
 
-    from fault_tolerant_llm_training_tpu.models import Transformer, get_config
-    from fault_tolerant_llm_training_tpu.training.state import TrainState
-    from fault_tolerant_llm_training_tpu.training.step import (
-        make_optimizer,
-        make_train_step,
+    from fault_tolerant_llm_training_tpu.models import get_config
+    from fault_tolerant_llm_training_tpu.utils.harness import (
+        synthetic_batch,
+        synthetic_state_and_step,
     )
     from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
 
-    cfg = get_config(args.model, seq_len=args.sequence_length,
-                     **({} if get_config(args.model).vocab_size > 0
-                        else {"vocab_size": 50257}))
-    model = Transformer(cfg)
-    opt = make_optimizer(3e-4, warmup_steps=10)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
-    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                       opt_state=opt.init(params))
-    rng = np.random.default_rng(0)
-    toks = jnp.asarray(rng.integers(
-        0, cfg.vocab_size, (args.batch_size, cfg.seq_len)).astype(np.int32))
-    labels = jnp.concatenate(
-        [toks[:, 1:], jnp.full((args.batch_size, 1), -100, jnp.int32)], axis=1)
-    step = jax.jit(make_train_step(model, opt, 1.0), donate_argnums=(0,))
+    cfg = get_config(args.model, seq_len=args.sequence_length)
+    state, step = synthetic_state_and_step(cfg)
+    toks, labels = synthetic_batch(cfg, args.batch_size)
     state, m = step(state, toks, labels)  # compile outside the trace
     hard_sync(m)
 
@@ -104,8 +89,8 @@ def main():
     print(f"\ndevice time by op family ({args.model}, "
           f"bs {args.batch_size}, seq {cfg.seq_len}, "
           f"backend {jax.default_backend()}):")
-    if not cats:
-        print("  (no device-lane events in trace — CPU backends emit "
+    if not cats or total <= 0:
+        print("  (no timed device-lane events in trace — CPU backends emit "
               "host-side traces only; run on TPU for the breakdown)")
         return
     print(f"{'ms/step':>10}  {'%':>5}  op family")

@@ -51,6 +51,37 @@ def test_map_dataset_wraparound_and_len(tiny_parquet, tok):
     assert len(ds[0]["input_ids"]) == 17  # seq_len + 1
 
 
+def test_sharded_parquet_source_matches_single_file(tmp_path, tok):
+    """A directory (or glob) of shards must index identically to the same
+    rows in one file — shard layout cannot perturb the checkpointable data
+    position (a single global row index)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    docs = [f"document number {i}" for i in range(30)]
+    single = tmp_path / "all.parquet"
+    pq.write_table(pa.table({"text": docs}), single)
+    shards = tmp_path / "shards"
+    shards.mkdir()
+    # deliberately unequal shard sizes; names sort lexicographically
+    for name, lo, hi in [("a.parquet", 0, 7), ("b.parquet", 7, 19),
+                         ("c.parquet", 19, 30)]:
+        pq.write_table(pa.table({"text": docs[lo:hi]}), shards / name)
+
+    one = ParquetDataset(str(single), tok, 16, training_samples=60)
+    for source in (str(shards), str(shards / "*.parquet")):
+        many = ParquetDataset(source, tok, 16, training_samples=60)
+        assert many._source.real_length == 30
+        for i in (0, 6, 7, 18, 19, 29, 45):  # incl. shard edges + wraparound
+            np.testing.assert_array_equal(one[i]["input_ids"],
+                                          many[i]["input_ids"])
+
+
+def test_sharded_parquet_source_errors(tmp_path, tok):
+    with pytest.raises(FileNotFoundError):
+        ParquetDataset(str(tmp_path / "none" / "*.parquet"), tok, 16, 10)
+
+
 def test_collator_shift_and_mask(tok):
     collator = CollatorForCLM(sequence_length=4, pad_token_id=tok.pad_token_id)
     ex = [{"input_ids": [1, 5, 6, tok.pad_token_id, tok.pad_token_id]}]
