@@ -58,10 +58,10 @@ class _ParquetText:
     def _resolve(path: str) -> List[str]:
         if os.path.isdir(path):
             files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+        elif os.path.exists(path):
+            files = [path]  # an existing literal file wins, even if globby
         elif any(c in path for c in "*?["):
             files = sorted(glob.glob(path))
-            if not files and os.path.exists(path):
-                files = [path]  # a literal file name that merely looks globby
         else:
             files = [path]
         if not files:

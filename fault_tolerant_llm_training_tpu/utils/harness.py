@@ -6,7 +6,7 @@ optimizer, same label convention (shift-by-one with a -100 tail, matching
 CollatorForCLM / ref dataset.py:44-53).
 """
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 import jax
