@@ -83,6 +83,14 @@ def _to_torch_tree(params, requires_grad=False):
         params)
 
 
+def _torch_loss(logits: torch.Tensor, labels: torch.Tensor,
+                vocab_size: int) -> torch.Tensor:
+    """ref train.py:94,101-102: sum-CE over (B*S, V) / valid-token count."""
+    return F.cross_entropy(
+        logits.float().view(-1, vocab_size), labels.view(-1),
+        ignore_index=-100, reduction="sum") / (labels != -100).sum()
+
+
 @pytest.fixture(scope="module")
 def setup():
     cfg = get_config("tiny", **FP32)
@@ -116,14 +124,54 @@ def test_loss_matches_torch_reference(setup):
         t_logits = _torch_forward(_to_torch_tree(params),
                                   torch.tensor(tokens, dtype=torch.long), cfg)
         t_labels = torch.tensor(labels, dtype=torch.long)
-        # ref train.py:94,101-102: sum-CE over (B*S, V) / valid-token count
-        t_loss = F.cross_entropy(
-            t_logits.float().view(-1, cfg.vocab_size), t_labels.view(-1),
-            ignore_index=-100, reduction="sum")
-        t_loss = t_loss / (t_labels != -100).sum()
+        t_loss = _torch_loss(t_logits, t_labels, cfg.vocab_size)
     assert int(n_valid) == int((t_labels != -100).sum())
     np.testing.assert_allclose(float(jax_loss), float(t_loss),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_train_step_matches_torch_reference(setup):
+    """One full update — grad clip (coefficient semantics of utils.py:58-63),
+    AdamW with torch defaults (train.py:68), LambdaLR warmup factor
+    (utils.py:43-53) — must move the weights identically in both frameworks."""
+    from fault_tolerant_llm_training_tpu.training.state import TrainState
+    from fault_tolerant_llm_training_tpu.training.step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg, model, params, tokens, labels = setup
+    lr, warmup, max_norm = 1e-3, 4, 1.0
+
+    opt = make_optimizer(lr, warmup_steps=warmup)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt.init(params))
+    step_fn = make_train_step(model, opt, max_norm)
+    new_state, _ = step_fn(state, jnp.asarray(tokens), jnp.asarray(labels))
+
+    t_params = _to_torch_tree(params, requires_grad=True)
+    leaves = [t for t in jax.tree_util.tree_leaves(t_params)]
+    optimizer = torch.optim.AdamW(leaves, lr=lr, betas=(0.9, 0.999),
+                                  eps=1e-8, weight_decay=0.01)
+    sched = torch.optim.lr_scheduler.LambdaLR(
+        optimizer, lambda s: min((s + 1) / (warmup + 1), 1.0))
+    t_labels = torch.tensor(labels, dtype=torch.long)
+    t_logits = _torch_forward(t_params,
+                              torch.tensor(tokens, dtype=torch.long), cfg)
+    _torch_loss(t_logits, t_labels, cfg.vocab_size).backward()
+    torch.nn.utils.clip_grad_norm_(leaves, max_norm)  # ref: utils.py:58-63
+    optimizer.step()
+    sched.step()
+
+    got = jax.tree_util.tree_map(np.asarray, new_state.params)
+    want = jax.tree_util.tree_map(lambda t: t.detach().numpy(), t_params)
+    flat_got = dict(jax.tree_util.tree_flatten_with_path(got)[0])
+    flat_want = dict(jax.tree_util.tree_flatten_with_path(want)[0])
+    assert flat_got.keys() == flat_want.keys()
+    for path in flat_got:
+        np.testing.assert_allclose(
+            flat_got[path], flat_want[path], rtol=2e-4, atol=2e-6,
+            err_msg=jax.tree_util.keystr(path))
 
 
 def test_gradients_match_torch_reference(setup):
@@ -139,10 +187,7 @@ def test_gradients_match_torch_reference(setup):
     t_labels = torch.tensor(labels, dtype=torch.long)
     t_logits = _torch_forward(t_params,
                               torch.tensor(tokens, dtype=torch.long), cfg)
-    t_loss = F.cross_entropy(
-        t_logits.float().view(-1, cfg.vocab_size), t_labels.view(-1),
-        ignore_index=-100, reduction="sum") / (t_labels != -100).sum()
-    t_loss.backward()
+    _torch_loss(t_logits, t_labels, cfg.vocab_size).backward()
 
     checks = [
         (("tok_embeddings", "embedding"),
