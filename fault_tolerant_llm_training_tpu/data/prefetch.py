@@ -24,12 +24,25 @@ import numpy as np
 
 
 class DevicePrefetcher:
-    """Wraps a DataLoader; yields ``(inputs_dev, labels_dev, data_state)``."""
+    """Wraps a DataLoader; yields ``(inputs_dev, labels_dev, data_state)``.
 
-    def __init__(self, loader, sharding=None, depth: int = 2):
+    Single-process: the worker thread both tokenizes and stages to the
+    device, so steady state never waits on the host. Multi-process: staging
+    moves to the consumer thread — issuing JAX operations from a background
+    thread concurrently with the main thread's dispatches is not safe when
+    a cross-process runtime (gloo on CPU pods) is underneath (observed as
+    collective payload-size mismatches); tokenization, the expensive part,
+    still runs ahead in the worker.
+    """
+
+    def __init__(self, loader, sharding=None, depth: int = 2,
+                 stage_in_worker: Optional[bool] = None):
         self.loader = loader
         self.sharding = sharding
         self.depth = max(1, depth)
+        if stage_in_worker is None:
+            stage_in_worker = jax.process_count() == 1
+        self.stage_in_worker = stage_in_worker
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -49,7 +62,9 @@ class DevicePrefetcher:
                 except StopIteration:
                     break
                 state = self.loader.get_state()
-                self._q.put((self._stage(inputs), self._stage(labels), state))
+                if self.stage_in_worker:
+                    inputs, labels = self._stage(inputs), self._stage(labels)
+                self._q.put((inputs, labels, state))
         except BaseException as e:  # surfaced to the consumer
             self._exc = e
         finally:
@@ -71,6 +86,9 @@ class DevicePrefetcher:
             if self._exc is not None:
                 raise self._exc
             raise StopIteration
+        if not self.stage_in_worker:
+            inputs, labels, state = item
+            return self._stage(inputs), self._stage(labels), state
         return item
 
     def stop(self):

@@ -75,7 +75,24 @@ class Trainer:
         logger.info(f"Experiment args: {cfg}")  # ref: train.py:14
 
         if cfg.distributed:
-            jax.distributed.initialize()
+            # jax.distributed auto-detects Slurm/TPU-pod topologies; outside
+            # those (e.g. a hand-launched multi-process CPU run) the JAX_*
+            # env vars spell it out explicitly.
+            kwargs = {}
+            explicit = ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                        "JAX_PROCESS_ID")
+            present = [v for v in explicit if v in os.environ]
+            if present and len(present) != len(explicit):
+                raise ValueError(
+                    f"explicit jax.distributed config needs all of "
+                    f"{explicit}; missing "
+                    f"{sorted(set(explicit) - set(present))}")
+            if present:
+                kwargs = dict(
+                    coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+                    num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+                    process_id=int(os.environ["JAX_PROCESS_ID"]))
+            jax.distributed.initialize(**kwargs)
         # Multihost: in-loop signal checks are cluster-wide agreements
         # (ft/multihost.py) so all hosts raise at the same boundary; setup
         # checks are local-only and skipped on pods (see _setup_check).
@@ -233,7 +250,7 @@ class Trainer:
 
     def _loop(self) -> None:
         cfg = self.cfg
-        inflight = collections.deque()
+        self._inflight = collections.deque()
         it = iter(self.prefetcher)
         sync_freq = max(1, cfg.signal_sync_frequency)
         first_iteration = True
@@ -248,6 +265,7 @@ class Trainer:
                 # since before setup (see _setup_check) is handled
                 # immediately even when the resumed step is off-boundary.
                 if first_iteration or self.training_step % sync_freq == 0:
+                    self._drain_inflight()
                     self.signal_flag.check(synced=True)
             else:
                 self.signal_flag.check()
@@ -259,14 +277,13 @@ class Trainer:
             # The jitted step pre-packs (loss, grad_norm) into one array so
             # _consume pays ONE host round trip per step, not one per metric
             # (each fetch is a full RPC on tunneled device transports).
-            inflight.append((self.training_step, metrics["packed"]))
-            while len(inflight) >= max(1, cfg.inflight):
-                self._consume(*inflight.popleft())
+            self._inflight.append((self.training_step, metrics["packed"]))
+            while len(self._inflight) >= max(1, cfg.inflight):
+                self._consume(*self._inflight.popleft())
             # Deterministic fault injection (ref: train.py:112-113): raised
             # while the counter still equals error_step, after the update.
             if cfg.raise_error and self.training_step == cfg.error_step:
-                while inflight:
-                    self._consume(*inflight.popleft())
+                self._drain_inflight()
                 self.error_is_replicated = True
                 raise Exception(
                     "Simulated exception to test signal handler", -1)
@@ -274,8 +291,20 @@ class Trainer:
             if (cfg.checkpoint_frequency
                     and self.training_step % cfg.checkpoint_frequency == 0):
                 self.save_checkpoint(wait=False, stop_prefetch=False)
-        while inflight:
-            self._consume(*inflight.popleft())
+        self._drain_inflight()
+
+    def _drain_inflight(self) -> None:
+        """Consume every dispatched-but-unfinished step.
+
+        Must run before ANY host-thread collective (signal agreement,
+        pre-save barrier): a dispatched step's collectives execute on
+        runtime threads, and a collective issued concurrently from the host
+        thread can interleave in different orders on different hosts
+        (observed as a gloo payload-size mismatch on multi-process CPU
+        runs). With the pipeline empty the host's collective is the only
+        one in flight anywhere."""
+        while self._inflight:
+            self._consume(*self._inflight.popleft())
 
     def _consume(self, step_no: int, packed: jnp.ndarray) -> None:
         """Pull one step's packed (loss, grad_norm) to the host — the only

@@ -100,6 +100,123 @@ assert verdict == 10
 """
 
 
+def _launch_pair(extra_args, job_id, n=2, signal_to=None,
+                 wait_for=None, timeout=240):
+    """Run n train.py processes as one jax.distributed cluster; returns
+    (returncodes, outputs). Optionally sends ``signal_to`` (a signal number)
+    to process 0 once ``wait_for`` appears in its output."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import threading
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = [sys.executable, os.path.join(repo_root, "train.py"),
+            "--tokenizer-name-or-path", "byte", "--model", "tiny",
+            "--sequence-length", "128", "--batch-size", "4",
+            "--logging-frequency", "2", "--distributed"] + extra_args
+    for attempt in range(3):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            coord = f"localhost:{s.getsockname()[1]}"
+        procs = []
+        for i in range(n):
+            env = {**os.environ, "PYTHONPATH": repo_root,
+                   "JAX_PLATFORMS": "cpu", "SLURM_JOB_ID": job_id,
+                   "JAX_COMPILATION_CACHE_DIR": "/tmp/jax_test_compile_cache",
+                   "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+                   "JAX_COORDINATOR_ADDRESS": coord,
+                   "JAX_NUM_PROCESSES": str(n), "JAX_PROCESS_ID": str(i)}
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                base, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env))
+        try:
+            if signal_to is not None:
+                # Reader thread so the timeout holds even if the process
+                # goes silent before printing the wait_for marker.
+                lines = []
+                fired = threading.Event()
+
+                def _reader():
+                    for line in procs[0].stdout:
+                        lines.append(line)
+                        if not fired.is_set() and wait_for in line:
+                            procs[0].send_signal(signal_to)
+                            fired.set()
+
+                rt = threading.Thread(target=_reader, daemon=True)
+                rt.start()
+                rt.join(timeout)
+                if rt.is_alive() or not fired.is_set():
+                    raise subprocess.TimeoutExpired(base, timeout)
+                procs[0].wait(timeout=timeout)
+                outs = ["".join(lines)]
+                outs += [p.communicate(timeout=timeout)[0] for p in procs[1:]]
+            else:
+                outs = [p.communicate(timeout=timeout)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            outs = [p.communicate()[0] or "" for p in procs]
+            continue
+        return [p.returncode for p in procs], outs
+    return [p.returncode for p in procs], outs
+
+
+def test_two_process_usr1_chain_and_resume(tmp_path, parquet2):
+    """End-to-end pod preemption: USR1 lands on host 0 only; the cluster
+    agrees, both hosts run the coordinated sharded save at the SAME step,
+    only host 0 resubmits, and a chained 2-process job resumes from that
+    step (the reference chain of SURVEY.md §3.4-3.5, multi-host edition)."""
+    import re
+    import signal as _sig
+
+    ckpt = str(tmp_path / "ckpts")
+    marker = tmp_path / "resub.txt"
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", "100000", "--signal-sync-frequency", "3",
+         "--resubmit-command", f"touch {marker}"],
+        job_id="mh_usr1", signal_to=_sig.SIGUSR1,
+        wait_for="Training step: 4")
+    assert rcs == [0, 0], outs
+    saved = [re.search(r"Checkpoint saved at step (\d+)", o) for o in outs]
+    assert all(saved), outs
+    assert saved[0].group(1) == saved[1].group(1), "hosts saved different steps"
+    assert "[EXIT HANDLER] Job timed out, saving checkpoint." in outs[0]
+    assert "sbatch requeued" in outs[0]
+    assert "sbatch requeued" not in outs[1]  # only process 0 chains the job
+    assert marker.exists()
+
+    step = int(saved[0].group(1))
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", str(step + 5), "--checkpoint-id", "mh_usr1"],
+        job_id="mh_resume")
+    assert rcs == [0, 0], outs
+    for o in outs:
+        assert f"Resuming training from training_step {step}" in o, o
+        assert "Training completed" in o
+
+
+@pytest.fixture(scope="module")
+def parquet2(tmp_path_factory):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(3)
+    words = ["alpha", "bravo", "charlie", "delta", "echo"]
+    docs = [" ".join(rng.choice(words, size=int(rng.integers(20, 120))))
+            for _ in range(128)]
+    path = tmp_path_factory.mktemp("data2") / "train_data.parquet"
+    pq.write_table(pa.table({"text": docs}), path)
+    return str(path)
+
+
 def test_two_process_agreement(tmp_path):
     """Real jax.distributed 2-process run: the host that saw no signal
     reaches the same USR1 verdict; only process 0 resubmits."""
