@@ -11,7 +11,7 @@ from typing import Dict, Iterator, Tuple
 import numpy as np
 
 from .collator import CollatorForCLM
-from .parquet import IterableParquetDataset, ParquetDataset
+from .parquet import ParquetDataset
 
 
 class DataLoader:

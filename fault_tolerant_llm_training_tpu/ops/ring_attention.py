@@ -15,7 +15,6 @@ accumulators.
 """
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

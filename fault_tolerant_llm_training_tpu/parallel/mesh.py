@@ -16,7 +16,6 @@ them to nearby devices in the default device order).
 """
 
 import contextlib
-import math
 from typing import Optional
 
 import jax

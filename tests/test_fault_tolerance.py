@@ -206,6 +206,27 @@ def test_sigterm_terminates_without_save(tmp_path, parquet):
     assert not (tmp_path / "ckpts" / "checkpoint_c1" / "0").exists()
 
 
+def test_periodic_checkpointing_and_latest_resume(tmp_path, parquet):
+    """--checkpoint-frequency N writes periodic async saves on top of the
+    reference's fault-triggered-only saves (SURVEY.md §5.4 build note), and
+    a chained job resumes from the LATEST periodic step, losing at most the
+    steps since it."""
+    argv = _args(tmp_path, parquet, **{"--training-steps": "17",
+                                       "--checkpoint-frequency": "5"})
+    rc, out = _run(argv, job_id="p1")
+    assert rc == 0, out
+    ckpt_root = tmp_path / "ckpts" / "checkpoint_p1"
+    steps = sorted(int(p.name) for p in ckpt_root.iterdir() if p.name.isdigit())
+    assert 15 in steps, steps  # latest periodic boundary before 17
+
+    rc, out2 = _run(_args(tmp_path, parquet,
+                          **{"--training-steps": "20",
+                             "--checkpoint-id": "p1"}), job_id="p2")
+    assert rc == 0, out2
+    assert "Resuming training from training_step 15" in out2, out2
+    assert "Training completed" in out2
+
+
 def test_nonfinite_gradient_routes_to_error_path(tmp_path, parquet):
     """A NaN/Inf grad norm must take the same -1 save path as the torch
     error_if_nonfinite raise (ref: utils.py:61)."""

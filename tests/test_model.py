@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from fault_tolerant_llm_training_tpu.models import Transformer, get_config
 from fault_tolerant_llm_training_tpu.models.llama import RMSNorm

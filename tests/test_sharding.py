@@ -4,7 +4,6 @@ note: DP/FSDP paths must be testable without a TPU)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import NamedSharding
 
 from fault_tolerant_llm_training_tpu.models import Transformer, get_config

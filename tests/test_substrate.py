@@ -2,7 +2,6 @@
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from fault_tolerant_llm_training_tpu.utils import (
     PRECISION_STR_TO_DTYPE,
