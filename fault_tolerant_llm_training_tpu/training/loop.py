@@ -64,6 +64,9 @@ class Trainer:
         # — only then may the exit handler run a *coordinated* save on a pod.
         self.error_is_replicated = False
         self._mesh_ctx = None
+        # Dispatched-but-unfinished steps (filled by _loop; exists from
+        # construction so save_checkpoint can drain it on setup-phase saves).
+        self._inflight = collections.deque()
 
         # Handlers first — signals during the (potentially long) setup are
         # deferred and handled at the next phase boundary instead of killing
@@ -250,7 +253,6 @@ class Trainer:
 
     def _loop(self) -> None:
         cfg = self.cfg
-        self._inflight = collections.deque()
         it = iter(self.prefetcher)
         sync_freq = max(1, cfg.signal_sync_frequency)
         first_iteration = True
@@ -293,7 +295,7 @@ class Trainer:
                 self.save_checkpoint(wait=False, stop_prefetch=False)
         self._drain_inflight()
 
-    def _drain_inflight(self) -> None:
+    def _drain_inflight(self, check: bool = True) -> None:
         """Consume every dispatched-but-unfinished step.
 
         Must run before ANY host-thread collective (signal agreement,
@@ -302,9 +304,18 @@ class Trainer:
         thread can interleave in different orders on different hosts
         (observed as a gloo payload-size mismatch on multi-process CPU
         runs). With the pipeline empty the host's collective is the only
-        one in flight anywhere."""
+        one in flight anywhere.
+
+        ``check=False`` (exit-handler saves): wait for completion but skip
+        the metric consumption — after a fault the remaining steps' metrics
+        may be non-finite too, and re-raising inside the save would abort
+        the checkpoint the handler exists to write."""
         while self._inflight:
-            self._consume(*self._inflight.popleft())
+            step_no, packed = self._inflight.popleft()
+            if check:
+                self._consume(step_no, packed)
+            else:
+                np.asarray(packed)  # completion only
 
     def _consume(self, step_no: int, packed: jnp.ndarray) -> None:
         """Pull one step's packed (loss, grad_norm) to the host — the only
@@ -345,6 +356,12 @@ class Trainer:
         if stop_prefetch:
             self.prefetcher.stop()
         if coordinated:
+            # The barrier is a host-thread collective: the dispatch pipeline
+            # must be empty first (see _drain_inflight). No-op when the
+            # caller (signal check, injection, loop end) already drained;
+            # check=False so a post-fault save cannot re-raise on the
+            # remaining steps' (possibly also non-finite) metrics.
+            self._drain_inflight(check=False)
             barrier("ftl:pre-save")  # all hosts drained to the same step
         step = int(jax.device_get(self.state.step))
         data_state = self._last_data_state or self.loader.get_state()
