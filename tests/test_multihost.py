@@ -202,6 +202,24 @@ def test_two_process_usr1_chain_and_resume(tmp_path, parquet2):
         assert "Training completed" in o
 
 
+def test_two_process_periodic_checkpointing(tmp_path, parquet2):
+    """Periodic coordinated saves on a pod: the pre-save barrier runs with
+    the dispatch pipeline drained (regression: entering the barrier with
+    steps in flight interleaves collectives differently per host and
+    crashes gloo), and both hosts finish with the checkpoints on disk."""
+    ckpt = str(tmp_path / "ckpts")
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", "12", "--checkpoint-frequency", "4"],
+        job_id="mh_per")
+    assert rcs == [0, 0], outs
+    for o in outs:
+        assert "Training completed" in o, o
+    root = tmp_path / "ckpts" / "checkpoint_mh_per"
+    steps = sorted(int(p.name) for p in root.iterdir() if p.name.isdigit())
+    assert 8 in steps, steps
+
+
 @pytest.fixture(scope="module")
 def parquet2(tmp_path_factory):
     import numpy as np
