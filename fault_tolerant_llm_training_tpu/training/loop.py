@@ -49,7 +49,7 @@ from ..utils.logging import (
     AUDIT_STEP_FMT,
     logger,
 )
-from ..utils.metrics import Throughput
+from ..utils.metrics import Throughput, hbm_usage_str
 
 
 class Trainer:
@@ -337,9 +337,11 @@ class Trainer:
                                               loss=self.last_loss))
             tps = self.throughput.tokens_per_sec
             if tps:
+                hbm = hbm_usage_str()
                 logger.info(
                     f"Metrics | step {step_no} | grad_norm "
-                    f"{grad_norm:.3f} | tokens/s {tps:,.0f}")
+                    f"{grad_norm:.3f} | tokens/s {tps:,.0f}"
+                    + (f" | hbm {hbm}" if hbm else ""))
 
     # --------------------------------------------------------------- saving
     def save_checkpoint(self, wait: bool = True,

@@ -40,3 +40,19 @@ def transformer_flops_per_token(n_params: int, seq_len: int, dim: int,
 
 def mfu(tokens_per_sec: float, flops_per_token: float, peak_flops: float) -> float:
     return tokens_per_sec * flops_per_token / peak_flops
+
+
+def hbm_usage_str() -> str:
+    """'x.x/y.y GB' for device 0, or '' where the backend exposes no
+    memory_stats (CPU; some remote transports)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return ""
+    used = stats.get("bytes_in_use")
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    if used is None:
+        return ""
+    s = f"{used / 1e9:.1f}"
+    return f"{s}/{limit / 1e9:.1f} GB" if limit else f"{s} GB"
