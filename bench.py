@@ -35,8 +35,8 @@ def main():
     on_tpu = jax.default_backend() != "cpu"
     seq = 2048
     batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "1"))
-    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "3"))
-    warmup = 3 if on_tpu else 1
+    steps = int(os.environ.get("BENCH_STEPS", "60" if on_tpu else "3"))
+    warmup = 5 if on_tpu else 1
 
     cfg = get_config("gpt2-125m", vocab_size=50257, seq_len=seq,
                      attention_impl=os.environ.get("BENCH_ATTN", "auto"))
