@@ -29,9 +29,12 @@ VPU economy (attention at head_dim 64 is VPU-bound on TPU, not MXU-bound):
   Backward accumulators run unscaled and are rescaled once per tile at the
   final write (exact: the accumulation is linear).
 
-lse/delta carry a trailing singleton dim — (B, H, S, 1) — because the Pallas
+lse carries a trailing singleton dim — (B, H, S, 1) — because the Pallas
 TPU lowering requires a block's last two dims to be (8k, 128m)-tileable or
 full; (block_q, 1) satisfies that where rank-3 (1, 1, block_q) does not.
+delta (rowwise dO . O) is computed inside the backward kernels from the
+do/o tiles (see _delta) — an XLA-side delta materializes fp32 casts of the
+full dO and O with layout-change copies at the custom-call boundary.
 
 Two kernel families, dispatched on sequence length:
 
@@ -124,6 +127,18 @@ def _online_softmax_step(q2, k, v, carry, q_start, k_start, masked):
     return m_new, l_new, acc_new
 
 
+def _delta(do, o):
+    """Rowwise dO . O — the softmax-normalization term, (bq, 1) fp32.
+
+    Computed in-kernel from tiles already resident in VMEM: an XLA-side
+    delta materializes fp32 casts of the full (B, H, S, D) dO and O with
+    layout-change copies around the custom-call boundary (profiled at
+    several ms/step, BASELINE.md breakdown).
+    """
+    return jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1, keepdims=True)
+
+
 def _dq_tile(q2, k, v, do, lse, delta, q_start, k_start, masked):
     """Unscaled dq contribution of one (bq, bk) tile (caller scales once)."""
     s = _scores(q2, k, q_start, k_start, masked)
@@ -201,14 +216,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0, 0] = (m + jnp.log2(l))[:, None]  # base-2, internal only
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, dq_ref, *,
                block_k: int, scale: float, causal: bool):
-    # q/do/dq: (1, 1, block_q, D); k/v: (1, 1, S, D);
-    # lse/delta: (1, 1, block_q, 1)
+    # q/do/o/dq: (1, 1, block_q, D); k/v: (1, 1, S, D); lse: (1, 1, block_q, 1)
     q2 = _prescale_q(q_ref[0, 0], scale)
     do = do_ref[0, 0]
     lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    delta = _delta(do, o_ref[0, 0])
     block_q, d = q2.shape
     s_k = k_ref.shape[2]
     q_start = pl.program_id(2) * block_q
@@ -228,10 +242,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                 dk_ref, dv_ref, *, block_q: int, scale: float, causal: bool):
     # Grid step = one KV head. k/v/dk/dv: (1, 1, block_k, D);
-    # q/do: (1, G, S, D) — this KV head's G query heads; lse/delta: (1, G, S, 1)
+    # q/do/o: (1, G, S, D) — this KV head's G query heads; lse: (1, G, S, 1).
+    # delta is recomputed per (g, q-block) each grid step: the (bq, D)
+    # multiply-reduce is negligible next to the tile's four matmuls, and
+    # caching it across k-steps would need a cross-row scratch protocol.
     k = k_ref[0, 0]
     v = v_ref[0, 0]
     block_k, d = k.shape
@@ -256,7 +273,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q2 = _prescale_q(q_ref[0, g, pl.ds(q_start, block_q), :], scale)
             do = do_ref[0, g, pl.ds(q_start, block_q), :]
             lse = lse_ref[0, g, pl.ds(q_start, block_q), :]
-            delta = delta_ref[0, g, pl.ds(q_start, block_q), :]
+            delta = _delta(do, o_ref[0, g, pl.ds(q_start, block_q), :])
             dk_c, dv_c = _dkv_tile(q2, k, v, do, lse, delta, q_start,
                                    k_start, masked)
             dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
@@ -325,11 +342,13 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_scr[...] + jnp.log2(l)[:, None]
 
 
-def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dq_scr, *, block_q: int, block_k: int,
-                      scale: float, causal: bool):
+def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+                      dq_ref, dq_scr, delta_scr, *, block_q: int,
+                      block_k: int, scale: float, causal: bool):
     # grid (b, h, qi, ki), ki innermost. Same tiling as _fwd_stream_kernel
-    # plus do/delta at qi; scratch dq (block_q, D) fp32.
+    # plus do/o at qi; scratch: dq (block_q, D) fp32 and delta (block_q, 1)
+    # fp32, both persisting across ki (delta depends only on the q tile, so
+    # it is computed once at ki == 0).
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * block_q
@@ -338,6 +357,7 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(ki == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
+        delta_scr[...] = _delta(do_ref[0, 0], o_ref[0, 0])
 
     useful, masked, n_total = _stream_bounds(ki, q_start, block_q, n_k,
                                              block_k, causal)
@@ -347,18 +367,21 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q2 = _prescale_q(q_ref[0, 0], scale)
         dq_scr[...] = dq_scr[...] + _dq_tile(
             q2, k_ref[0, 0], v_ref[0, 0], do_ref[0, 0], lse_ref[0, 0],
-            delta_ref[0, 0], q_start, k_start, masked)
+            delta_scr[...], q_start, k_start, masked)
 
     @pl.when(ki == n_total - 1)
     def _emit():
         dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                        dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
                        block_k: int, scale: float, causal: bool):
     # grid (b, kv_head, ki, qi), qi innermost. k/v/dk/dv: (1, 1, block_k, D)
-    # at ki; q/do: (1, G, block_q, D) at qi; lse/delta: (1, G, block_q, 1).
+    # at ki; q/do/o: (1, G, block_q, D) at qi; lse: (1, G, block_q, 1).
+    # delta is recomputed per (g, qi) step — negligible next to the tile's
+    # matmuls, and qi is the INNER grid axis so a single-tile cache cannot
+    # hold it across the k rows.
     # Scratch dk/dv (block_k, D) fp32, persists across qi.
     qi = pl.program_id(3)
     n_q = pl.num_programs(3)
@@ -387,7 +410,8 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         for g in range(group):  # static loop: accumulate the GQA group
             q2 = _prescale_q(q_ref[0, g], scale)
             dk_c, dv_c = _dkv_tile(q2, k, v, do_ref[0, g], lse_ref[0, g],
-                                   delta_ref[0, g], q_start, k_start, masked)
+                                   _delta(do_ref[0, g], o_ref[0, g]),
+                                   q_start, k_start, masked)
             dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
         dk_scr[...], dv_scr[...] = dk_acc, dv_acc
 
@@ -509,9 +533,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     dq_bq, dq_bk = _blocks(s, DQ_BLOCK_Q, DQ_BLOCK_K)
     dkv_bq, dkv_bk = _blocks(s, DKV_BLOCK_Q, DKV_BLOCK_K)
     scale = 1.0 / (d ** 0.5)
-    # delta_i = sum_d dO_i . O_i  (rowwise), the softmax-normalization term.
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1,
-                    keepdims=True)
+    # delta (rowwise dO . O) is computed inside the kernels from the do/o
+    # tiles (see _delta) — no fp32 materialization at the XLA level.
 
     if s <= STREAM_THRESHOLD:
         q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
@@ -522,12 +545,12 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
             functools.partial(_dq_kernel, block_k=dq_bk, scale=scale,
                               causal=causal),
             grid=(b, h, s // dq_bq),
-            in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
+            in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, q_spec],
             out_specs=pl.BlockSpec((1, 1, dq_bq, d),
                                    lambda bi, hi, qi: (bi, hi, qi, 0)),
             out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, delta)
+        )(qt, kt, vt, dot, lse, ot)
     else:
         q_spec = pl.BlockSpec((1, 1, dq_bq, d),
                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
@@ -545,13 +568,14 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
             functools.partial(_dq_stream_kernel, block_q=dq_bq, block_k=dq_bk,
                               scale=scale, causal=causal),
             grid=(b, h, s // dq_bq, s // dq_bk),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec],
             out_specs=pl.BlockSpec((1, 1, dq_bq, d),
                                    lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            scratch_shapes=[pltpu.VMEM((dq_bq, d), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((dq_bq, d), jnp.float32),
+                            pltpu.VMEM((dq_bq, 1), jnp.float32)],
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, delta)
+        )(qt, kt, vt, dot, lse, ot)
 
     # Grid over KV heads: block index maps pick up this head's group of G
     # query heads ((1, G, ...) blocks); dk/dv land at KV-head granularity —
@@ -565,14 +589,14 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
                               causal=causal),
             grid=(b, kv_heads, s // dkv_bk),
             in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                      rowgrp_spec],
+                      qgrp_spec],
             out_specs=[kv_spec, kv_spec],
             out_shape=[
                 jax.ShapeDtypeStruct(kt.shape, k.dtype),
                 jax.ShapeDtypeStruct(vt.shape, v.dtype),
             ],
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, delta)
+        )(qt, kt, vt, dot, lse, ot)
     else:
         kv_spec = pl.BlockSpec((1, 1, dkv_bk, d),
                                lambda bi, hi, ki, qi: (bi, hi, ki, 0))
@@ -589,7 +613,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
                               block_k=dkv_bk, scale=scale, causal=causal),
             grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
             in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                      rowgrp_spec],
+                      qgrp_spec],
             out_specs=[kv_spec, kv_spec],
             out_shape=[
                 jax.ShapeDtypeStruct(kt.shape, k.dtype),
@@ -598,7 +622,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
             scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
                             pltpu.VMEM((dkv_bk, d), jnp.float32)],
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, delta)
+        )(qt, kt, vt, dot, lse, ot)
     dq_out = jnp.transpose(dq, (0, 2, 1, 3))
     dk_out = jnp.transpose(dk, (0, 2, 1, 3))
     dv_out = jnp.transpose(dv, (0, 2, 1, 3))
