@@ -206,6 +206,18 @@ def test_sigterm_terminates_without_save(tmp_path, parquet):
     assert not (tmp_path / "ckpts" / "checkpoint_c1" / "0").exists()
 
 
+def test_profile_dir_writes_trace(tmp_path, parquet):
+    """--profile-dir wraps the loop in jax.profiler traces (SURVEY §5.1 —
+    the reference has no profiling subsystem at all)."""
+    prof = tmp_path / "trace"
+    argv = _args(tmp_path, parquet, **{"--training-steps": "4",
+                                       "--profile-dir": str(prof)})
+    rc, out = _run(argv, job_id="prof1")
+    assert rc == 0, out
+    assert list(prof.rglob("*.trace.json.gz")), (
+        f"no trace written under {prof}")
+
+
 def test_periodic_checkpointing_and_latest_resume(tmp_path, parquet):
     """--checkpoint-frequency N writes periodic async saves on top of the
     reference's fault-triggered-only saves (SURVEY.md §5.4 build note), and

@@ -70,3 +70,25 @@ def test_dtype_registry():
     assert PRECISION_STR_TO_DTYPE["bf16"] == jnp.bfloat16
     assert PRECISION_STR_TO_DTYPE["fp32"] == jnp.float32
     assert set(PRECISION_STR_TO_DTYPE) == {"fp16", "bf16", "fp32", "fp64"}
+
+
+def test_hbm_usage_str_formats_and_degrades():
+    """Best-effort HBM telemetry: formats when the backend reports stats,
+    silently empty elsewhere (CPU backends return no memory_stats)."""
+    from unittest import mock
+
+    from fault_tolerant_llm_training_tpu.utils import metrics
+
+    class _Dev:
+        def memory_stats(self):
+            return {"bytes_in_use": 2_500_000_000, "bytes_limit": 16_000_000_000}
+
+    with mock.patch("jax.local_devices", return_value=[_Dev()]):
+        assert metrics.hbm_usage_str() == "2.5/16.0 GB"
+
+    class _NoStats:
+        def memory_stats(self):
+            return None
+
+    with mock.patch("jax.local_devices", return_value=[_NoStats()]):
+        assert metrics.hbm_usage_str() == ""
