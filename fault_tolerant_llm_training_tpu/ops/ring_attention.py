@@ -64,6 +64,13 @@ def _ring_local(q, k, v, *, sp: int, axis_name: str):
     for t in range(sp):
         src = (my - t) % sp  # which global block this device holds at step t
         k_pos = src * s_loc + jnp.arange(s_loc)
+        # Future blocks (src > my) are fully masked and mathematically
+        # no-ops. Skipping their compute would save FLOPs but no wall-clock:
+        # every ring step is gated by the slowest device through the
+        # lockstep ppermute, and some device always computes at every step.
+        # The real fix is zigzag/striped block placement (each device holds
+        # one early and one mirrored late chunk, balancing causal work) —
+        # a data-layout change tracked in ROUND_NOTES.md.
         m, l, acc = _local_update(qg, k_blk, v_blk, m, l, acc, q_pos, k_pos,
                                   scale)
         if t + 1 < sp:

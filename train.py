@@ -52,7 +52,12 @@ def train(cfg) -> None:
         sys.exit(0)  # ref: train.py:129 — exit 0 even on error
     finally:
         if trainer is not None:
-            trainer.close()
+            try:
+                trainer.close()
+            except Exception:
+                # The exit-0 contract (Slurm must never mark the job failed,
+                # ref train.py:119,129) survives a teardown failure.
+                logger.exception("close() failed; exit code preserved")
 
 
 if __name__ == "__main__":
