@@ -206,6 +206,33 @@ def test_sigterm_terminates_without_save(tmp_path, parquet):
     assert not (tmp_path / "ckpts" / "checkpoint_c1" / "0").exists()
 
 
+def test_usr1_with_periodic_saves_in_flight(tmp_path, parquet):
+    """USR1 while async periodic checkpointing is active: the fault-path
+    save must serialize behind any in-flight periodic write (Orbax commit
+    order), resubmit once, and the chained job must resume from the fault
+    step — not a stale periodic step."""
+    marker = tmp_path / "resub.txt"
+    argv = _args(tmp_path, parquet,
+                 **{"--training-steps": "100000",
+                    "--checkpoint-frequency": "2",
+                    "--resubmit-command": f"touch {marker}"})
+    rc, out = _run(argv, job_id="pr1", send_signal=signal.SIGUSR1,
+                   wait_for="Training step: 5")
+    assert rc == 0, out
+    assert "[EXIT HANDLER] Job timed out, saving checkpoint." in out
+    saved = [l for l in out.splitlines() if "Checkpoint saved at step" in l]
+    assert saved, out
+    fault_step = int(saved[-1].rsplit(" ", 1)[1])
+    assert marker.exists()
+
+    rc, out2 = _run(_args(tmp_path, parquet,
+                          **{"--training-steps": str(fault_step + 5),
+                             "--checkpoint-id": "pr1"}), job_id="pr2")
+    assert rc == 0, out2
+    assert f"Resuming training from training_step {fault_step}" in out2, out2
+    assert "Training completed" in out2
+
+
 def test_profile_dir_writes_trace(tmp_path, parquet):
     """--profile-dir wraps the loop in jax.profiler traces (SURVEY §5.1 —
     the reference has no profiling subsystem at all)."""
