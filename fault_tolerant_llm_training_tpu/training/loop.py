@@ -173,6 +173,7 @@ class Trainer:
             lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
             abstract, self.state_shardings)
         abstract_sharded = self.abstract_state
+        self._warn_if_state_exceeds_hbm(abstract_sharded)
 
         if read_mngr is not None:
             self.state, data_state, _ = read_mngr.restore(abstract_sharded)
@@ -217,6 +218,26 @@ class Trainer:
                                            depth=cfg.prefetch)
         self.throughput = Throughput(
             tokens_per_step=cfg.batch_size * cfg.sequence_length)
+
+    def _warn_if_state_exceeds_hbm(self, abstract_sharded) -> None:
+        """Pre-flight capacity estimate: warn (don't fail — remat and fusion
+        change actuals) when the sharded TrainState alone exceeds a device's
+        memory, instead of letting XLA die later in a raw OOM dump. No-op on
+        backends that expose no memory_stats."""
+        from ..utils.metrics import device_memory_stats
+
+        _, limit = device_memory_stats()
+        if not limit:
+            return
+        per_device = 0
+        for leaf in jax.tree_util.tree_leaves(abstract_sharded):
+            shard = leaf.sharding.shard_shape(leaf.shape)
+            per_device += int(np.prod(shard)) * leaf.dtype.itemsize
+        if per_device > limit:
+            logger.warning(
+                f"TrainState needs ~{per_device / 1e9:.1f} GB per device but "
+                f"the device reports {limit / 1e9:.1f} GB; expect an OOM — "
+                f"shard more (--fsdp/--tp) or pick a smaller --model")
 
     def _setup_check(self) -> None:
         """Phase-boundary signal check during setup.

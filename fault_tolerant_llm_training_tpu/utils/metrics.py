@@ -42,16 +42,21 @@ def mfu(tokens_per_sec: float, flops_per_token: float, peak_flops: float) -> flo
     return tokens_per_sec * flops_per_token / peak_flops
 
 
-def hbm_usage_str() -> str:
-    """'x.x/y.y GB' for device 0, or '' where the backend exposes no
-    memory_stats (CPU; some remote transports)."""
+def device_memory_stats():
+    """(bytes_in_use, bytes_limit) for device 0; (None, None) where the
+    backend exposes no memory_stats (CPU; some remote transports)."""
     try:
         import jax
         stats = jax.local_devices()[0].memory_stats() or {}
     except Exception:
-        return ""
-    used = stats.get("bytes_in_use")
-    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        return None, None
+    return (stats.get("bytes_in_use"),
+            stats.get("bytes_limit") or stats.get("bytes_reservable_limit"))
+
+
+def hbm_usage_str() -> str:
+    """'x.x/y.y GB' for device 0, or '' without backend memory stats."""
+    used, limit = device_memory_stats()
     if used is None:
         return ""
     s = f"{used / 1e9:.1f}"
