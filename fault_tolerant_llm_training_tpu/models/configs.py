@@ -31,6 +31,10 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16  # activations / compute
     param_dtype: jnp.dtype = jnp.bfloat16  # weights (and hence AdamW moments)
     attention_impl: str = "auto"
+    # Sequence layout under sequence parallelism: "zigzag" (each shard holds
+    # one early + one mirrored late chunk — balances causal work around the
+    # ring at ~2x fewer FLOPs; ops/ring_attention.py) or "contiguous".
+    sp_layout: str = "zigzag"
     # Token-embedding lookup: "gather" (jnp.take), "one_hot" (iota one-hot
     # matmul — contracts the vocab axis on the MXU with a psum, which is how
     # a vocab-sharded table must be read under tensor parallelism), or

@@ -108,7 +108,8 @@ class Attention(nn.Module):
         impl = cfg.attention_impl
         if impl in ("auto", "ring") and mesh_axis_size("sequence") > 1:
             from ..ops.ring_attention import ring_attention
-            out = ring_attention(q, k, v, axis_name="sequence")
+            out = ring_attention(q, k, v, axis_name="sequence",
+                                 zigzag=(cfg.sp_layout == "zigzag"))
         else:
             if impl == "ring":  # ring requested but no sequence axis active
                 impl = "auto"

@@ -12,12 +12,32 @@ Causality without wasted work: device ``i`` starts with its own KV block
 (the diagonal, causal-masked), then receives blocks ``i-1, i-2, ...``; blocks
 from the future are fully masked and contribute nothing to the softmax
 accumulators.
+
+Two sequence layouts are supported:
+
+- ``contiguous`` — shard ``i`` holds global positions ``[i*S/sp, (i+1)*S/sp)``.
+  Simple, but causal work is imbalanced: device 0's queries attend one block
+  while device sp-1's attend all of them, and since every ring step is gated
+  by the lockstep ``ppermute``, the busiest device sets the pace (a per-step
+  ``lax.cond`` skip of fully-masked blocks was tried and reverted — it saves
+  FLOPs but zero wall-clock).
+- ``zigzag`` — the sequence is split into ``2*sp`` chunks and shard ``i``
+  holds chunks ``(i, 2*sp-1-i)``: one early chunk plus its mirrored late
+  chunk. Then at every ring step ``t>0`` each device has exactly half a
+  block of *unmasked* work — either all its queries against the visiting
+  early chunk (KV from an earlier device) or its late queries against both
+  visiting chunks (KV from a later device) — two equal-FLOP ``lax.cond``
+  branches, so the ring stays in lockstep while doing ~2x fewer FLOPs than
+  contiguous, evenly. The layout permutation is applied once to the token
+  stream by the train step (training/step.py) — RoPE and the causal mask see
+  true global positions; the summed CE loss is permutation-invariant.
 """
 
 import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import active_mesh
@@ -31,11 +51,15 @@ NEG_INF = -1e30
 
 
 def _local_update(qg, k_blk, v_blk, m, l, acc, q_pos, k_pos, scale):
-    """One online-softmax accumulation of q against a single KV block."""
+    """One online-softmax accumulation of q against a single KV block.
+
+    ``q_pos``/``k_pos`` of None means the caller guarantees every (q, k)
+    pair in the block is causally valid — no mask is applied."""
     s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_blk,
                    preferred_element_type=jnp.float32) * scale
-    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
-    s = jnp.where(mask, s, NEG_INF)
+    if q_pos is not None:
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
+        s = jnp.where(mask, s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     p = jnp.exp(s - m_new[..., None])
     alpha = jnp.exp(m - m_new)
@@ -44,6 +68,99 @@ def _local_update(qg, k_blk, v_blk, m, l, acc, q_pos, k_pos, scale):
         "bkgqs,bskd->bkgqd", p.astype(v_blk.dtype), v_blk,
         preferred_element_type=jnp.float32)
     return m_new, l_new, acc_new
+
+
+def _unmasked_update(qg, k_blk, v_blk, m, l, acc, scale):
+    return _local_update(qg, k_blk, v_blk, m, l, acc, None, None, scale)
+
+
+def zigzag_ok(seq_len: int, sp: int) -> bool:
+    """Whether the zigzag layout applies: needs 2*sp even chunks."""
+    return sp > 1 and seq_len % (2 * sp) == 0
+
+
+def zigzag_perm(seq_len: int, sp: int) -> np.ndarray:
+    """Global sequence permutation for the zigzag layout.
+
+    ``permuted[j] = original[perm[j]]``: split the sequence into ``2*sp``
+    chunks; contiguous shard ``i`` of the permuted sequence holds chunks
+    ``(i, 2*sp-1-i)``. Static (trace-time) data."""
+    c = seq_len // (2 * sp)
+    chunks = np.arange(seq_len, dtype=np.int32).reshape(2 * sp, c)
+    order = [x for i in range(sp) for x in (i, 2 * sp - 1 - i)]
+    return chunks[order].reshape(-1)
+
+
+def zigzag_layout_active(cfg, seq_len: int, sp: int) -> bool:
+    """The single predicate deciding whether the train step permutes tokens
+    into the zigzag layout — must mirror the model's attention dispatch
+    (models/llama.py: ring is used iff impl is auto|ring and sp > 1) plus
+    the ring op's own ``zigzag_ok`` divisibility fallback, or masking and
+    layout would disagree."""
+    return (sp > 1 and cfg.attention_impl in ("auto", "ring")
+            and cfg.sp_layout == "zigzag" and zigzag_ok(seq_len, sp))
+
+
+def _zigzag_pos(idx, sp: int, c: int):
+    """(2c,) true global positions of the shard holding chunks
+    ``(idx, 2*sp-1-idx)``."""
+    lo = idx * c + jnp.arange(c)
+    hi = (2 * sp - 1 - idx) * c + jnp.arange(c)
+    return jnp.concatenate([lo, hi])
+
+
+def _ring_local_zigzag(q, k, v, *, sp: int, axis_name: str):
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    kv_heads = k.shape[2]
+    g = h // kv_heads
+    c = s_loc // 2
+    scale = 1.0 / (d ** 0.5)
+    qg = q.reshape(b, s_loc, kv_heads, g, d)
+    q_pos = _zigzag_pos(my, sp, c)
+
+    m = jnp.full((b, kv_heads, g, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, kv_heads, g, s_loc), jnp.float32)
+    acc = jnp.zeros((b, kv_heads, g, s_loc, d), jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    k_blk, v_blk = k, v
+    for t in range(sp):
+        if t == 0:
+            # Diagonal: both chunk pairs are our own — positional causal mask.
+            m, l, acc = _local_update(qg, k_blk, v_blk, m, l, acc, q_pos,
+                                      q_pos, scale)
+        else:
+            src = (my - t) % sp
+
+            def from_earlier(ops, kb=k_blk, vb=v_blk):
+                # Visiting KV came from an earlier ring slot: chunk src is
+                # entirely in our past, chunk 2*sp-1-src entirely in our
+                # future — so every query attends exactly the early half.
+                m, l, acc = ops
+                return _unmasked_update(qg, kb[:, :c], vb[:, :c], m, l, acc,
+                                        scale)
+
+            def from_later(ops, kb=k_blk, vb=v_blk):
+                # Visiting KV came from a later slot: our early chunk sees
+                # nothing, our late chunk (2*sp-1-my) sees both visiting
+                # chunks in full. Same FLOPs as the other branch.
+                m, l, acc = ops
+                m2, l2, acc2 = _unmasked_update(
+                    qg[:, c:], kb, vb, m[..., c:], l[..., c:],
+                    acc[..., c:, :], scale)
+                return (jnp.concatenate([m[..., :c], m2], axis=-1),
+                        jnp.concatenate([l[..., :c], l2], axis=-1),
+                        jnp.concatenate([acc[..., :c, :], acc2], axis=-2))
+
+            m, l, acc = jax.lax.cond(src < my, from_earlier, from_later,
+                                     (m, l, acc))
+        if t + 1 < sp:
+            k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
+
+    out = acc / l[..., None]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s_loc, h, d)
+    return out.astype(q.dtype)
 
 
 def _ring_local(q, k, v, *, sp: int, axis_name: str):
@@ -68,9 +185,7 @@ def _ring_local(q, k, v, *, sp: int, axis_name: str):
         # no-ops. Skipping their compute would save FLOPs but no wall-clock:
         # every ring step is gated by the slowest device through the
         # lockstep ppermute, and some device always computes at every step.
-        # The real fix is zigzag/striped block placement (each device holds
-        # one early and one mirrored late chunk, balancing causal work) —
-        # a data-layout change tracked in ROUND_NOTES.md.
+        # The fix is the zigzag layout above, which balances causal work.
         m, l, acc = _local_update(qg, k_blk, v_blk, m, l, acc, q_pos, k_pos,
                                   scale)
         if t + 1 < sp:
@@ -81,18 +196,23 @@ def _ring_local(q, k, v, *, sp: int, axis_name: str):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str = "sequence", mesh=None
-                   ) -> jax.Array:
+def ring_attention(q, k, v, axis_name: str = "sequence", mesh=None,
+                   zigzag: bool = False) -> jax.Array:
     """Causal GQA attention with the sequence dim sharded over ``axis_name``.
 
     q: (B, S, H, D); k/v: (B, S, K, D) — global (jit) view; internally a
     shard_map over the active mesh rotates KV blocks around the ring.
+    With ``zigzag=True`` the inputs must already be in the zigzag sequence
+    layout (``zigzag_perm``; the train step applies it) — the op then does
+    ~2x fewer, evenly balanced FLOPs per device.
     """
     mesh = mesh or active_mesh()
     if mesh is None or mesh.shape[axis_name] == 1:
         from .attention import xla_attention
         return xla_attention(q, k, v, causal=True)
     sp = mesh.shape[axis_name]
+    local = _ring_local_zigzag if zigzag and zigzag_ok(q.shape[1], sp) \
+        else _ring_local
     # Degrade per-axis when a dim is not divisible by its mesh axes (e.g. the
     # batch-1 dummy used by model.init): shard_map then replicates that dim,
     # which is always semantically valid.
@@ -103,7 +223,7 @@ def ring_attention(q, k, v, axis_name: str = "sequence", mesh=None
                  if q.shape[2] % tp == 0 and k.shape[2] % tp == 0 else None)
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = _shard_map(
-        functools.partial(_ring_local, sp=sp, axis_name=axis_name),
+        functools.partial(local, sp=sp, axis_name=axis_name),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..ops.ring_attention import zigzag_layout_active, zigzag_perm
+from ..parallel.mesh import mesh_axis_size
 from ..training.state import TrainState
 from ..utils.grad_clip import clip_grads_with_norm
 from ..utils.schedules import linear_warmup_constant
@@ -63,7 +65,20 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     """
 
     def loss_fn(params, inputs, labels):
-        logits = model.apply({"params": params}, inputs)
+        sp = mesh_axis_size("sequence")
+        cfg = getattr(model, "cfg", None)
+        if cfg is not None and zigzag_layout_active(cfg, inputs.shape[1], sp):
+            # Zigzag sequence layout (ops/ring_attention.py): permute the
+            # token stream once so each sequence shard holds one early + one
+            # mirrored late chunk; RoPE gets true positions, and the summed
+            # CE below is permutation-invariant, so only attention's ring
+            # schedule sees the layout.
+            perm = jnp.asarray(zigzag_perm(inputs.shape[1], sp))
+            inputs, labels = inputs[:, perm], labels[:, perm]
+            positions = jnp.broadcast_to(perm[None, :], inputs.shape)
+            logits = model.apply({"params": params}, inputs, positions)
+        else:
+            logits = model.apply({"params": params}, inputs)
         return cross_entropy_loss(logits, labels)
 
     def train_step(state: TrainState, inputs: jax.Array, labels: jax.Array):

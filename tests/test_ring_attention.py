@@ -6,7 +6,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from fault_tolerant_llm_training_tpu.ops.attention import xla_attention
-from fault_tolerant_llm_training_tpu.ops.ring_attention import ring_attention
+from fault_tolerant_llm_training_tpu.ops.ring_attention import (
+    ring_attention,
+    zigzag_perm,
+)
 from fault_tolerant_llm_training_tpu.parallel.mesh import make_mesh, use_mesh
 
 
@@ -36,6 +39,61 @@ def test_ring_matches_reference_sp8_gqa(eight_devices):
         got = jax.jit(lambda q, k, v: ring_attention(q, k, v))(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_perm_is_permutation():
+    perm = zigzag_perm(64, 4)
+    assert sorted(perm.tolist()) == list(range(64))
+    # shard 0 of 4 holds chunks 0 and 7 (of 8): positions 0-7 then 56-63
+    np.testing.assert_array_equal(perm[:16],
+                                  list(range(8)) + list(range(56, 64)))
+
+
+def test_zigzag_ring_matches_reference_sp4(eight_devices):
+    q, k, v = _qkv()
+    want = xla_attention(q, k, v, causal=True)
+    perm = zigzag_perm(q.shape[1], 4)
+    inv = np.argsort(perm)
+    mesh = make_mesh(dp=2, sp=4)
+    with use_mesh(mesh):
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, zigzag=True))(
+            q[:, perm], k[:, perm], v[:, perm])
+    np.testing.assert_allclose(np.asarray(got)[:, inv], np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_ring_matches_reference_sp8_gqa(eight_devices):
+    q, k, v = _qkv(b=1, s=128, h=8, kv=2, d=8, seed=3)
+    want = xla_attention(q, k, v, causal=True)
+    perm = zigzag_perm(q.shape[1], 8)
+    inv = np.argsort(perm)
+    mesh = make_mesh(dp=1, sp=8)
+    with use_mesh(mesh):
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, zigzag=True))(
+            q[:, perm], k[:, perm], v[:, perm])
+    np.testing.assert_allclose(np.asarray(got)[:, inv], np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_ring_gradients_match(eight_devices):
+    q, k, v = _qkv(b=1, s=64, h=2, kv=2, d=8, seed=5)
+    perm = zigzag_perm(q.shape[1], 4)
+    inv = np.argsort(perm)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=True) ** 2)
+
+    def loss_zz(qp, kp, vp):
+        return jnp.sum(ring_attention(qp, kp, vp, zigzag=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    mesh = make_mesh(dp=1, sp=4)
+    with use_mesh(mesh):
+        g_zz = jax.jit(jax.grad(loss_zz, argnums=(0, 1, 2)))(
+            q[:, perm], k[:, perm], v[:, perm])
+    for a, b in zip(g_ref, g_zz):
+        np.testing.assert_allclose(np.asarray(b)[:, inv], np.asarray(a),
+                                   rtol=5e-4, atol=5e-5)
 
 
 def test_ring_gradients_match(eight_devices):
