@@ -39,7 +39,7 @@ from ..models import Transformer, get_config
 from ..parallel.mesh import make_mesh, use_mesh
 from ..parallel.sharding import batch_pspec, param_pspecs
 from ..training.state import TrainState
-from ..training.step import make_optimizer, make_train_step
+from ..training.step import make_eval_step, make_optimizer, make_train_step
 from ..utils.config import JOBID, TrainConfig
 from ..utils.dtypes import PRECISION_STR_TO_DTYPE
 from ..utils.grad_clip import NonFiniteGradientError
@@ -219,6 +219,26 @@ class Trainer:
         self.throughput = Throughput(
             tokens_per_step=cfg.batch_size * cfg.sequence_length)
 
+        # --- held-out evaluation (no reference counterpart; SURVEY §5.5
+        # notes training loss is the reference's only metric) ---
+        self._compiled_eval = None
+        if cfg.eval_frequency:
+            if cfg.eval_batches < 1:
+                raise ValueError(
+                    f"--eval-batches {cfg.eval_batches} must be >= 1 when "
+                    f"--eval-frequency is set")
+            eval_ds = ParquetDataset(
+                cfg.eval_dataset or cfg.dataset, self.tokenizer,
+                cfg.sequence_length, cfg.batch_size * cfg.eval_batches)
+            self.eval_loader = DataLoader(
+                eval_ds, cfg.batch_size,
+                CollatorForCLM(cfg.sequence_length,
+                               self.tokenizer.pad_token_id))
+            self._eval_batches_cache = None  # tokenized once, first pass
+            self._compiled_eval = jax.jit(make_eval_step(self.model)).lower(
+                self.abstract_state.params, batch_struct,
+                batch_struct).compile()
+
     def _warn_if_state_exceeds_hbm(self, abstract_sharded) -> None:
         """Pre-flight capacity estimate: warn (don't fail — remat and fusion
         change actuals) when the sharded TrainState alone exceeds a device's
@@ -314,7 +334,35 @@ class Trainer:
             if (cfg.checkpoint_frequency
                     and self.training_step % cfg.checkpoint_frequency == 0):
                 self.save_checkpoint(wait=False, stop_prefetch=False)
+            if (self._compiled_eval is not None
+                    and self.training_step % cfg.eval_frequency == 0):
+                self._evaluate()
         self._drain_inflight()
+        if (self._compiled_eval is not None
+                and self.training_step % cfg.eval_frequency != 0):
+            self._evaluate()  # final eval unless the last step just ran one
+
+    def _evaluate(self) -> None:
+        """One held-out pass: ``--eval-batches`` batches, token-weighted mean
+        NLL + perplexity. The eval set is fixed and rewound each pass, so
+        evaluation is deterministic, independent of the training data
+        position, and adds no checkpoint state; its tokenized batches are
+        cached after the first pass, and all forward calls are dispatched
+        before any result is fetched (no host/device serialization)."""
+        if self._eval_batches_cache is None:
+            self.eval_loader.set_state({"kind": "map", "next_index": 0})
+            self._eval_batches_cache = list(self.eval_loader)
+        packed = []
+        for inputs, labels in self._eval_batches_cache:
+            inputs = jax.device_put(inputs, self.batch_sharding)
+            labels = jax.device_put(labels, self.batch_sharding)
+            packed.append(self._compiled_eval(self.state.params, inputs,
+                                              labels))
+        totals = np.sum([np.asarray(p) for p in packed], axis=0)
+        loss = float(totals[0]) / max(float(totals[1]), 1.0)
+        ppl = math.exp(min(loss, 700.0))
+        logger.info(f"Eval | step {self.training_step} | loss {loss:.4f} | "
+                    f"ppl {ppl:.2f} | tokens {int(totals[1])}")
 
     def _drain_inflight(self, check: bool = True) -> None:
         """Consume every dispatched-but-unfinished step.

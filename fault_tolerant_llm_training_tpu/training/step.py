@@ -54,6 +54,44 @@ def make_optimizer(learning_rate: float, warmup_steps: int
                        weight_decay=0.01)
 
 
+def model_loss(model, params, inputs, labels
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Forward + CE, shared by the train and eval steps (so the sequence-
+    layout handling below can never diverge between them).
+
+    Returns (mean loss, num_valid_tokens)."""
+    sp = mesh_axis_size("sequence")
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and zigzag_layout_active(cfg, inputs.shape[1], sp):
+        # Zigzag sequence layout (ops/ring_attention.py): permute the
+        # token stream once so each sequence shard holds one early + one
+        # mirrored late chunk; RoPE gets true positions, and the summed
+        # CE below is permutation-invariant, so only attention's ring
+        # schedule sees the layout.
+        perm = jnp.asarray(zigzag_perm(inputs.shape[1], sp))
+        inputs, labels = inputs[:, perm], labels[:, perm]
+        positions = jnp.broadcast_to(perm[None, :], inputs.shape)
+        logits = model.apply({"params": params}, inputs, positions)
+    else:
+        logits = model.apply({"params": params}, inputs)
+    return cross_entropy_loss(logits, labels)
+
+
+def make_eval_step(model):
+    """Forward-only loss for held-out evaluation (no reference counterpart —
+    the reference never evaluates; SURVEY.md §5.5 notes loss is its only
+    metric). Returns packed (sum_nll, num_valid) as one fp32 array so the
+    host aggregates exactly across batches with one D2H transfer each:
+    mean = sum(sum_nll) / sum(num_valid), weighting every token equally
+    even when batches carry different pad counts."""
+
+    def eval_step(params, inputs, labels):
+        loss, num_valid = model_loss(model, params, inputs, labels)
+        return jnp.stack((loss * num_valid, num_valid.astype(jnp.float32)))
+
+    return eval_step
+
+
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     grad_max_norm: float):
     """Build the pure ``(state, inputs, labels) -> (state, metrics)`` step.
@@ -65,21 +103,7 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     """
 
     def loss_fn(params, inputs, labels):
-        sp = mesh_axis_size("sequence")
-        cfg = getattr(model, "cfg", None)
-        if cfg is not None and zigzag_layout_active(cfg, inputs.shape[1], sp):
-            # Zigzag sequence layout (ops/ring_attention.py): permute the
-            # token stream once so each sequence shard holds one early + one
-            # mirrored late chunk; RoPE gets true positions, and the summed
-            # CE below is permutation-invariant, so only attention's ring
-            # schedule sees the layout.
-            perm = jnp.asarray(zigzag_perm(inputs.shape[1], sp))
-            inputs, labels = inputs[:, perm], labels[:, perm]
-            positions = jnp.broadcast_to(perm[None, :], inputs.shape)
-            logits = model.apply({"params": params}, inputs, positions)
-        else:
-            logits = model.apply({"params": params}, inputs)
-        return cross_entropy_loss(logits, labels)
+        return model_loss(model, params, inputs, labels)
 
     def train_step(state: TrainState, inputs: jax.Array, labels: jax.Array):
         (loss, num_tokens), grads = jax.value_and_grad(loss_fn, has_aux=True)(

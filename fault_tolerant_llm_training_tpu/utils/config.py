@@ -57,6 +57,9 @@ class TrainConfig:
     data_loading: str = "map"  # map (ParquetDataset path) | packed (iterable)
     legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
     checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
+    eval_dataset: str = ""  # held-out parquet; empty = use --dataset
+    eval_frequency: int = 0  # evaluate every N steps (0 = off)
+    eval_batches: int = 8  # batches per evaluation pass
     prefetch: int = 2  # host->device prefetch depth (reference has none)
     inflight: int = 2  # max dispatched-but-unfinished steps (bounds signal latency)
     # Multihost: steps between cluster-wide signal agreements. The agreement
@@ -155,6 +158,13 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Fix the reference packing quirks (buffer discard / doc re-read)")
     parser.add_argument("--checkpoint-frequency", type=int, default=0,
                         help="Save every N steps; 0 = fault-triggered only (reference behavior)")
+    parser.add_argument("--eval-dataset", type=str, default="",
+                        help="Held-out parquet (file/dir/glob) for --eval-frequency; "
+                             "empty = evaluate on --dataset")
+    parser.add_argument("--eval-frequency", type=int, default=0,
+                        help="Evaluate every N steps (0 = off)")
+    parser.add_argument("--eval-batches", type=int, default=8,
+                        help="Batches per evaluation pass")
     parser.add_argument("--prefetch", type=int, default=2)
     parser.add_argument("--inflight", type=int, default=2)
     parser.add_argument("--signal-sync-frequency", type=int, default=5)

@@ -1,0 +1,104 @@
+"""Held-out evaluation: the eval step's aggregation math, determinism, and
+the CLI wiring (--eval-dataset / --eval-frequency / --eval-batches).
+
+No reference counterpart (SURVEY.md §5.5: training loss is the reference's
+only metric) — this is a beyond-parity subsystem, so the tests pin down our
+own contract: token-weighted mean NLL over a deterministic held-out pass.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+from fault_tolerant_llm_training_tpu.training.step import (
+    cross_entropy_loss,
+    make_eval_step,
+)
+
+from test_fault_tolerance import (  # reuse the CLI harness + data fixture
+    _args,
+    _run,
+    parquet,  # noqa: F401  (imported fixtures register in this module)
+)
+
+FP32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _model_and_batch(seed=0):
+    cfg = get_config("tiny", attention_impl="xla", **FP32)
+    model = Transformer(cfg)
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    labels = np.concatenate(
+        [toks[:, 1:], np.full((2, 1), -100, np.int32)], axis=1)
+    # mask a few extra labels so num_valid != B*S (exercises the weighting)
+    labels[0, :5] = -100
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    return model, params, jnp.asarray(toks), jnp.asarray(labels)
+
+
+def test_eval_step_matches_loss_times_tokens():
+    model, params, toks, labels = _model_and_batch()
+    packed = jax.jit(make_eval_step(model))(params, toks, labels)
+    logits = model.apply({"params": params}, toks)
+    loss, n = cross_entropy_loss(logits, labels)
+    np.testing.assert_allclose(float(packed[0]), float(loss) * float(n),
+                               rtol=1e-6)
+    assert float(packed[1]) == float(n) == 57  # 2*32 - 2 shifts - 5 masked
+
+
+def test_eval_step_is_deterministic():
+    model, params, toks, labels = _model_and_batch()
+    f = jax.jit(make_eval_step(model))
+    a = np.asarray(f(params, toks, labels))
+    b = np.asarray(f(params, toks, labels))
+    np.testing.assert_array_equal(a, b)
+
+
+def _eval_lines(out):
+    return re.findall(
+        r"Eval \| step (\d+) \| loss ([\d.]+) \| ppl ([\d.]+)", out)
+
+
+def test_cli_eval_frequency(tmp_path, parquet):
+    rc, out = _run(_args(tmp_path, parquet, **{"--eval-frequency": 10,
+                                               "--eval-batches": 2}),
+                   job_id="e0")
+    assert rc == 0, out
+    lines = _eval_lines(out)
+    # steps 10, 20, 30; no duplicate final eval (30 % 10 == 0)
+    assert [int(s) for s, *_ in lines] == [10, 20, 30], out
+    for _, loss, ppl in lines:
+        assert np.isfinite(float(loss)) and np.isfinite(float(ppl))
+
+
+def test_cli_eval_is_deterministic_and_final_eval_fires(tmp_path, parquet):
+    """Same params -> same eval loss: step 12 is past the final train step
+    of a 12-step run, exercising the trailing off-boundary eval; two runs
+    with identical seeds must report identical eval losses."""
+    args = _args(tmp_path / "a", parquet,
+                 **{"--eval-frequency": 7, "--eval-batches": 2,
+                    "--training-steps": 12})
+    rc, out1 = _run(args, job_id="e1")
+    assert rc == 0, out1
+    steps = [int(s) for s, *_ in _eval_lines(out1)]
+    assert steps == [7, 12], out1  # in-loop at 7, trailing final at 12
+    rc, out2 = _run(_args(tmp_path / "b", parquet,
+                          **{"--eval-frequency": 7, "--eval-batches": 2,
+                             "--training-steps": 12}), job_id="e2")
+    assert rc == 0, out2
+    assert _eval_lines(out1) == _eval_lines(out2)
+
+
+def test_cli_separate_eval_dataset(tmp_path, parquet, tiny_parquet):
+    """--eval-dataset points evaluation at a different file than --dataset."""
+    rc, out = _run(_args(tmp_path, parquet,
+                         **{"--eval-frequency": 15, "--eval-batches": 2,
+                            "--eval-dataset": str(tiny_parquet)}),
+                   job_id="e3")
+    assert rc == 0, out
+    assert len(_eval_lines(out)) == 2  # steps 15 and 30
