@@ -1,0 +1,197 @@
+"""Checkpoint interop with the reference's ``torch.save`` format.
+
+The reference checkpoints one monolithic dict
+``{model, optimizer, lr_scheduler, training_step}`` (ref: utils.py:74-81)
+where ``model`` is the torch ``state_dict`` of the Llama-style transformer
+(ref: model.py:315-380) and ``optimizer`` is torch AdamW's
+``{state: {idx: {step, exp_avg, exp_avg_sq}}, param_groups}``. This module
+maps that format to/from this framework's ``TrainState`` so a reference user
+can bring a half-trained torch checkpoint to TPU (and go back):
+
+- parameter names: torch registration order / dotted names
+  (``layers.3.attention.wq.weight``) <-> flax tree paths
+  (``layers_3/attention/wq/kernel``);
+- orientation: ``nn.Linear`` stores (out, in); flax ``Dense`` kernels are
+  (in, out) — transposed both ways. Embeddings and norm scales map 1:1
+  (``weight`` <-> ``embedding`` / ``scale``);
+- optimizer moments: torch ``exp_avg``/``exp_avg_sq`` live in parameter
+  space, so they transpose exactly like their parameters into optax's
+  ``ScaleByAdamState.mu``/``nu``; torch's per-param ``step`` and the LambdaLR
+  ``last_epoch`` both equal the training step, which here is the single
+  update count (``training/state.py``: the schedule is a pure function of
+  it);
+- RoPE needs no weight transform: the reference's complex-arithmetic
+  rotation and our real interleaved cos/sin form compute the identical
+  function of the same weights (pinned by tests/test_torch_parity.py), and
+  the reference's ``freqs_cis`` buffer is non-persistent (model.py:342-344)
+  so it never appears in checkpoints.
+
+Conversion is lossless: dtypes are preserved leaf-for-leaf, so a
+convert -> convert round trip is bit-exact and a resumed run continues with
+the same loss trajectory as a native resume (tests/test_convert.py).
+
+No torch import is needed: the torch pickle is read/written through
+``torch.load``/``torch.save`` only in the CLI (scripts/convert_checkpoint.py);
+this module works on plain numpy-convertible leaves.
+"""
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def reference_param_names(n_layers: int) -> List[Tuple[str, Tuple[str, ...], bool]]:
+    """(torch_name, flax_path, transpose) in the reference's registration
+    order (ref model.py: tok_embeddings at :340, per-block wq/wk/wv/wo at
+    :170-177, w1/w2/w3 at :249-251, attention_norm/ffn_norm at :291-292,
+    then norm :350 and output :352) — which is also torch AdamW's param
+    index order, since ``model.parameters()`` follows registration."""
+    names = [("tok_embeddings.weight", ("tok_embeddings", "embedding"), False)]
+    for i in range(n_layers):
+        for lin in ("wq", "wk", "wv", "wo"):
+            names.append((f"layers.{i}.attention.{lin}.weight",
+                          (f"layers_{i}", "attention", lin, "kernel"), True))
+        for lin in ("w1", "w2", "w3"):
+            names.append((f"layers.{i}.feed_forward.{lin}.weight",
+                          (f"layers_{i}", "feed_forward", lin, "kernel"), True))
+        names.append((f"layers.{i}.attention_norm.weight",
+                      (f"layers_{i}", "attention_norm", "scale"), False))
+        names.append((f"layers.{i}.ffn_norm.weight",
+                      (f"layers_{i}", "ffn_norm", "scale"), False))
+    names.append(("norm.weight", ("norm", "scale"), False))
+    names.append(("output.weight", ("output", "kernel"), True))
+    return names
+
+
+def _get(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _set(tree, path, value):
+    for k in path[:-1]:
+        tree = tree.setdefault(k, {})
+    tree[path[-1]] = value
+
+
+def _to_torch_orientation(tree, n_layers) -> Dict[str, np.ndarray]:
+    """Flax param-shaped tree -> {torch_name: array} (transposing linears)."""
+    out = {}
+    for torch_name, path, transpose in reference_param_names(n_layers):
+        arr = np.asarray(_get(tree, path))
+        out[torch_name] = arr.T if transpose else arr
+    return out
+
+
+def _from_torch_orientation(sd: Dict[str, np.ndarray], n_layers) -> dict:
+    """{torch_name: array} -> flax param-shaped tree (transposing linears)."""
+    tree: dict = {}
+    for torch_name, path, transpose in reference_param_names(n_layers):
+        if torch_name not in sd:
+            raise KeyError(
+                f"reference checkpoint is missing {torch_name!r}; is the "
+                f"--model preset (n_layers={n_layers}) right for this file?")
+        arr = np.asarray(sd[torch_name])
+        _set(tree, path, arr.T if transpose else arr)
+    return tree
+
+
+def state_to_torch_ckpt(state, n_layers: int, learning_rate: float,
+                        warmup_steps: int = 10,
+                        weight_decay: float = 0.01) -> dict:
+    """TrainState -> the reference's checkpoint dict (numpy leaves).
+
+    ``optimizer``/``lr_scheduler`` entries follow torch AdamW's and
+    LambdaLR's ``state_dict()`` schema (ref loads them at train.py:70-77).
+    The exported ``lr``/``_last_lr`` carry the *warmup-scaled* current rate
+    — what a native torch checkpoint would hold mid-warmup — computed from
+    the same schedule the trainer uses (utils/schedules.py)."""
+    from ..utils.schedules import linear_warmup_constant
+
+    step = int(np.asarray(state.step))
+    current_lr = float(linear_warmup_constant(learning_rate,
+                                              warmup_steps)(step))
+    adams = [s for s in jax.tree_util.tree_leaves(
+        state.opt_state,
+        is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))
+        if isinstance(s, optax.ScaleByAdamState)]
+    if not adams:
+        raise ValueError("opt_state holds no ScaleByAdamState; only AdamW "
+                         "states convert to the reference format")
+    adam = adams[0]
+    mu = _to_torch_orientation(adam.mu, n_layers)
+    nu = _to_torch_orientation(adam.nu, n_layers)
+    names = [n for n, _, _ in reference_param_names(n_layers)]
+    opt_state = {
+        i: {"step": np.float32(step), "exp_avg": mu[name],
+            "exp_avg_sq": nu[name]}
+        for i, name in enumerate(names)
+    }
+    return {
+        "model": _to_torch_orientation(state.params, n_layers),
+        "optimizer": {
+            "state": opt_state,
+            "param_groups": [{
+                "lr": current_lr, "betas": (0.9, 0.999), "eps": 1e-8,
+                "weight_decay": weight_decay, "amsgrad": False,
+                "maximize": False, "foreach": None, "capturable": False,
+                "differentiable": False, "fused": None,
+                "params": list(range(len(names))),
+            }],
+        },
+        # LambdaLR schema (its load_state_dict pops 'lr_lambdas' first)
+        "lr_scheduler": {"last_epoch": step, "_step_count": step + 1,
+                         "lr_lambdas": [None], "base_lrs": [learning_rate],
+                         "_last_lr": [current_lr]},
+        "training_step": step,
+    }
+
+
+def state_from_torch_ckpt(ckpt: dict, model, optimizer, param_dtype):
+    """The reference's checkpoint dict -> TrainState.
+
+    ``model``/``optimizer`` are this framework's Transformer and optax
+    transform — the optimizer is initialized for structure, then the Adam
+    moments and every update count are replaced from the checkpoint."""
+    from ..training.state import TrainState
+
+    n_layers = model.cfg.n_layers
+    step = int(ckpt["training_step"])
+    cast = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda a: jnp.asarray(a, param_dtype), t)
+    params = cast(_from_torch_orientation(ckpt["model"], n_layers))
+
+    names = [n for n, _, _ in reference_param_names(n_layers)]
+    # normalize: torch state keys may round-trip as strings (e.g. JSON)
+    opt = {int(k): v for k, v in ckpt["optimizer"]["state"].items()}
+    if sorted(opt) != list(range(len(names))):
+        raise ValueError(
+            f"optimizer state has param indices {sorted(opt)} but the "
+            f"{n_layers}-layer reference model has {len(names)} parameters")
+    mu_sd = {name: np.asarray(opt[i]["exp_avg"])
+             for i, name in enumerate(names)}
+    nu_sd = {name: np.asarray(opt[i]["exp_avg_sq"])
+             for i, name in enumerate(names)}
+    mu = cast(_from_torch_orientation(mu_sd, n_layers))
+    nu = cast(_from_torch_orientation(nu_sd, n_layers))
+
+    opt_state = optimizer.init(params)
+    count = jnp.asarray(step, jnp.int32)
+
+    def fix(entry):
+        if isinstance(entry, optax.ScaleByAdamState):
+            return entry._replace(count=count, mu=mu, nu=nu)
+        if isinstance(entry, optax.ScaleByScheduleState):
+            return entry._replace(count=count)
+        return entry
+
+    opt_state = jax.tree_util.tree_map(
+        fix, opt_state,
+        is_leaf=lambda x: isinstance(
+            x, (optax.ScaleByAdamState, optax.ScaleByScheduleState)))
+    return TrainState(step=jnp.asarray(step, jnp.int32), params=params,
+                      opt_state=opt_state)

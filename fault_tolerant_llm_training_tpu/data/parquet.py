@@ -117,7 +117,11 @@ class ParquetDataset:
         return {"kind": "map", "next_index": self._next_index}
 
     def set_state(self, state: Dict) -> None:
-        assert state["kind"] == "map", state
+        if state.get("kind") != "map":
+            raise ValueError(
+                f"checkpoint data state is kind {state.get('kind')!r} but "
+                f"--data-loading map expects 'map'; resume with the data "
+                f"loading mode the checkpoint was saved with")
         self._next_index = int(state["next_index"])
 
 
@@ -182,7 +186,12 @@ class IterableParquetDataset:
         }
 
     def set_state(self, state: Dict) -> None:
-        assert state["kind"] == "packed", state
+        if state.get("kind") != "packed":
+            raise ValueError(
+                f"checkpoint data state is kind {state.get('kind')!r} but "
+                f"--data-loading packed expects 'packed'; resume with the "
+                f"data loading mode the checkpoint was saved with (converted "
+                f"reference checkpoints are always 'map')")
         self.current_index = int(state["current_index"])
         self.token_buffer = list(state["token_buffer"])
         self.legacy = bool(state["legacy"])

@@ -1,0 +1,156 @@
+"""Convert checkpoints between the reference's torch format and this
+framework's Orbax layout — the migration path for reference users.
+
+The reference saves ``checkpoint_{JOBID}.ckpt`` via one ``torch.save``
+(ref: utils.py:74-81); this framework saves an Orbax directory
+``{path}/checkpoint_{JOBID}/{step}`` (checkpoint/manager.py). Both
+directions preserve every tensor bit-for-bit (see checkpoint/convert.py),
+so training resumed from a converted checkpoint continues exactly like a
+native resume.
+
+Usage (model flags must match the checkpoint's shape):
+
+  # torch -> TPU: bring a reference checkpoint here, then resume with
+  #   train.py --checkpoint-id <job-id> ...
+  python scripts/convert_checkpoint.py to-tpu \
+      --input checkpoints/checkpoint_444664.ckpt \
+      --checkpoint-path checkpoints --job-id 444664 \
+      --model llama3-8b --vocab-size 131072 --batch-size 1
+
+  # TPU -> torch: produce a file the reference's train.py can load
+  #   (torch.load + load_state_dict, ref train.py:20-24,56-77)
+  python scripts/convert_checkpoint.py to-torch \
+      --checkpoint-path checkpoints --job-id local \
+      --model gpt2-125m --vocab-size 50257 \
+      --output checkpoints/checkpoint_local.ckpt
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    common = dict(model="gpt2-125m", vocab_size=0, sequence_length=2048)
+    for name in ("to-tpu", "to-torch"):
+        s = sub.add_parser(name)
+        s.add_argument("--model", type=str, default=common["model"])
+        s.add_argument("--vocab-size", type=int, required=True)
+        s.add_argument("--sequence-length", type=int,
+                       default=common["sequence_length"])
+        s.add_argument("--learning-rate", type=float, default=1e-5)
+        s.add_argument("--lr-warmup-steps", type=int, default=10)
+        s.add_argument("--checkpoint-path", type=str, required=True,
+                       help="Orbax checkpoint root (as in train.py)")
+        s.add_argument("--job-id", type=str, required=True,
+                       help="the {JOBID} in checkpoint_{JOBID}")
+        s.add_argument("--step", type=int, default=None,
+                       help="Orbax step (default: latest / training_step)")
+    sub.choices["to-tpu"].add_argument(
+        "--input", type=str, required=True, help="reference .ckpt file")
+    sub.choices["to-tpu"].add_argument(
+        "--batch-size", type=int, default=1,
+        help="training batch size: the data position resumes at "
+             "step*batch-size samples (the reference's replay semantics, "
+             "ref train.py:36-39)")
+    sub.choices["to-torch"].add_argument(
+        "--output", type=str, required=True, help="reference .ckpt to write")
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.checkpoint.convert import (
+        state_from_torch_ckpt,
+        state_to_torch_ckpt,
+    )
+    from fault_tolerant_llm_training_tpu.checkpoint.manager import (
+        CheckpointManager,
+    )
+    from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+    from fault_tolerant_llm_training_tpu.training.state import TrainState
+    from fault_tolerant_llm_training_tpu.training.step import make_optimizer
+
+    import ml_dtypes
+
+    def _t2n(t):
+        """torch tensor -> numpy, routing bf16 through a uint16 view
+        (torch cannot .numpy() a BFloat16 tensor)."""
+        if not hasattr(t, "numpy"):
+            return t
+        if t.dtype == torch.bfloat16:
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return t.numpy()
+
+    def _n2t(a):
+        """numpy -> torch tensor, same bf16 routing for from_numpy."""
+        if not isinstance(a, np.ndarray):
+            return a
+        a = np.ascontiguousarray(a)
+        if a.dtype == ml_dtypes.bfloat16:
+            return torch.from_numpy(a.view(np.uint16)).view(torch.bfloat16)
+        return torch.from_numpy(a)
+
+    cfg = get_config(args.model, vocab_size=args.vocab_size,
+                     seq_len=args.sequence_length)
+    model = Transformer(cfg)
+    optimizer = make_optimizer(args.learning_rate, args.lr_warmup_steps)
+    mngr = CheckpointManager(args.checkpoint_path, args.job_id,
+                             enable_async=False)
+
+    if args.cmd == "to-tpu":
+        ckpt = torch.load(args.input, map_location="cpu",
+                          weights_only=False)
+        ckpt["model"] = {k: _t2n(v) for k, v in ckpt["model"].items()}
+        for entry in ckpt["optimizer"]["state"].values():
+            for k in ("exp_avg", "exp_avg_sq"):
+                entry[k] = _t2n(entry[k])
+        state = state_from_torch_ckpt(ckpt, model, optimizer,
+                                      cfg.param_dtype)
+        step = int(ckpt["training_step"])
+        if args.step is not None and args.step != step:
+            # state.step is the checkpoint's training_step; saving it under
+            # a different step number would silently desync model and data
+            p.error(f"--step {args.step} does not match the checkpoint's "
+                    f"training_step {step}; omit --step for to-tpu")
+        # Reference replay semantics (ref train.py:36-39): after N steps the
+        # map-style loader has consumed N*batch_size samples. Resume the
+        # converted checkpoint with --data-loading map (the mode the
+        # reference's trainer actually uses); the packed iterator's position
+        # is not reconstructible from a reference checkpoint.
+        data_state = {"kind": "map",
+                      "next_index": step * args.batch_size}
+        mngr.save(step, state, data_state, wait=True)
+        print(f"wrote {mngr.directory}/{step} (resume with "
+              f"train.py --checkpoint-id {args.job_id} --data-loading map)")
+    else:
+        def init_fn(key):
+            params = model.init(key, jnp.zeros((1, 8), jnp.int32))["params"]
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=optimizer.init(params))
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        state, _, step = mngr.restore(abstract, step=args.step)
+        out = state_to_torch_ckpt(state, cfg.n_layers, args.learning_rate,
+                                  warmup_steps=args.lr_warmup_steps)
+        out["model"] = {k: _n2t(v) for k, v in out["model"].items()}
+        for entry in out["optimizer"]["state"].values():
+            entry["step"] = torch.tensor(float(entry["step"]))
+            entry["exp_avg"] = _n2t(entry["exp_avg"])
+            entry["exp_avg_sq"] = _n2t(entry["exp_avg_sq"])
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        torch.save(out, args.output)
+        print(f"wrote {args.output} (step {step})")
+    mngr.close()
+
+
+if __name__ == "__main__":
+    main()

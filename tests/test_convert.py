@@ -1,0 +1,218 @@
+"""Checkpoint interop with the reference's torch format
+(checkpoint/convert.py + scripts/convert_checkpoint.py).
+
+The migration contract: a reference user's ``torch.save`` checkpoint
+(ref: utils.py:74-81 — {model, optimizer, lr_scheduler, training_step})
+converts losslessly into a TrainState and back, and training resumed from a
+converted checkpoint is bit-exact with a native resume.
+"""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fault_tolerant_llm_training_tpu.checkpoint.convert import (
+    reference_param_names,
+    state_from_torch_ckpt,
+    state_to_torch_ckpt,
+)
+from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+from fault_tolerant_llm_training_tpu.training.state import TrainState
+from fault_tolerant_llm_training_tpu.training.step import (
+    make_optimizer,
+    make_train_step,
+)
+
+from test_fault_tolerance import (  # reuse the CLI harness + data fixture
+    REPO,
+    _args,
+    _env,
+    _losses_by_step,
+    _run,
+    parquet,  # noqa: F401  (imported fixture registers in this module)
+)
+
+FP32 = dict(dtype=jnp.float32, param_dtype=jnp.float32, attention_impl="xla")
+
+
+def _trained_state(n_steps=3):
+    cfg = get_config("tiny", **FP32)
+    model = Transformer(cfg)
+    opt = make_optimizer(1e-3, warmup_steps=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt.init(params))
+    step_fn = jax.jit(make_train_step(model, opt, 1.0))
+    rng = np.random.default_rng(3)
+    for _ in range(n_steps):
+        toks = rng.integers(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+        labels = np.concatenate(
+            [toks[:, 1:], np.full((2, 1), -100, np.int32)], axis=1)
+        state, metrics = step_fn(state, jnp.asarray(toks), jnp.asarray(labels))
+    return cfg, model, opt, state, step_fn
+
+
+def test_name_map_matches_reference_layout():
+    """Names, order, and orientation of the torch-side dict: registration
+    order (AdamW's param indexing) and nn.Linear's (out, in) shapes."""
+    cfg, model, opt, state, _ = _trained_state(n_steps=0)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, 1e-3)
+    names = [n for n, _, _ in reference_param_names(cfg.n_layers)]
+    assert list(ckpt["model"]) == names  # exact registration order
+    assert names[0] == "tok_embeddings.weight"
+    assert names[1] == "layers.0.attention.wq.weight"
+    assert names[-1] == "output.weight"
+    # nn.Linear orientation: torch (out, in) == flax kernel (in, out).T
+    wq_t = ckpt["model"]["layers.0.attention.wq.weight"]
+    wq_f = state.params["layers_0"]["attention"]["wq"]["kernel"]
+    assert wq_t.shape == wq_f.shape[::-1]
+    np.testing.assert_array_equal(wq_t.T, np.asarray(wq_f))
+    # w1 is non-square (64 -> 192 in the tiny preset): transposition bugs
+    # cannot hide behind symmetric shapes
+    w1 = ckpt["model"]["layers.0.feed_forward.w1.weight"]
+    assert w1.shape[0] != w1.shape[1]
+    # optimizer indices cover every param in order, with per-param step
+    opt_sd = ckpt["optimizer"]
+    assert sorted(opt_sd["state"]) == list(range(len(names)))
+    assert opt_sd["param_groups"][0]["params"] == list(range(len(names)))
+    assert ckpt["lr_scheduler"]["last_epoch"] == 0
+
+
+def test_export_carries_warmup_scaled_lr():
+    """Mid-warmup export must hold the *current* scaled lr (what a native
+    torch checkpoint stores), not the base rate — LambdaLR semantics:
+    factor = (step+1)/(warmup+1)."""
+    cfg, model, opt, state, _ = _trained_state(n_steps=3)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, 1e-3, warmup_steps=10)
+    want = 1e-3 * (3 + 1) / (10 + 1)
+    assert ckpt["optimizer"]["param_groups"][0]["lr"] == pytest.approx(want)
+    assert ckpt["lr_scheduler"]["_last_lr"] == [pytest.approx(want)]
+    assert ckpt["lr_scheduler"]["base_lrs"] == [1e-3]
+    # past warmup the scaled rate equals the base rate
+    late = state.replace(step=jnp.asarray(50, jnp.int32))
+    ckpt = state_to_torch_ckpt(late, cfg.n_layers, 1e-3, warmup_steps=10)
+    assert ckpt["optimizer"]["param_groups"][0]["lr"] == pytest.approx(1e-3)
+
+
+def test_string_keyed_optimizer_state_accepted():
+    """torch state keys may round-trip as strings (e.g. via JSON)."""
+    cfg, model, opt, state, _ = _trained_state(n_steps=2)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, 1e-3)
+    ckpt["optimizer"]["state"] = {
+        str(k): v for k, v in ckpt["optimizer"]["state"].items()}
+    back = state_from_torch_ckpt(ckpt, model, opt, jnp.float32)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_round_trip_is_bit_exact_and_resumes_identically():
+    cfg, model, opt, state, step_fn = _trained_state(n_steps=3)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, 1e-3)
+    back = state_from_torch_ckpt(ckpt, model, opt, jnp.float32)
+    assert int(back.step) == int(state.step) == 3
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the states are interchangeable: one more identical step from each
+    rng = np.random.default_rng(9)
+    toks = rng.integers(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    labels = np.concatenate(
+        [toks[:, 1:], np.full((2, 1), -100, np.int32)], axis=1)
+    _, m1 = step_fn(state, jnp.asarray(toks), jnp.asarray(labels))
+    _, m2 = step_fn(back, jnp.asarray(toks), jnp.asarray(labels))
+    np.testing.assert_array_equal(np.asarray(m1["packed"]),
+                                  np.asarray(m2["packed"]))
+
+
+def test_moments_land_on_the_right_leaves():
+    """Distinguishable exp_avg values must land on their matching flax
+    leaves, transposed — catches index-order and orientation mix-ups."""
+    cfg, model, opt, state, _ = _trained_state(n_steps=0)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, 1e-3)
+    names = [n for n, _, _ in reference_param_names(cfg.n_layers)]
+    for i, name in enumerate(names):
+        ckpt["optimizer"]["state"][i]["exp_avg"] = np.full_like(
+            ckpt["optimizer"]["state"][i]["exp_avg"], float(i))
+    back = state_from_torch_ckpt(ckpt, model, opt, jnp.float32)
+    mu = back.opt_state[0].mu
+    w1_idx = names.index("layers.0.feed_forward.w1.weight")
+    got = np.asarray(mu["layers_0"]["feed_forward"]["w1"]["kernel"])
+    assert got.shape == state.params["layers_0"]["feed_forward"]["w1"][
+        "kernel"].shape
+    np.testing.assert_array_equal(got, np.full_like(got, float(w1_idx)))
+
+
+@pytest.mark.parametrize("wrong", ["missing_key", "bad_indices"])
+def test_malformed_reference_checkpoint_fails_loudly(wrong):
+    cfg, model, opt, state, _ = _trained_state(n_steps=0)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, 1e-3)
+    if wrong == "missing_key":
+        del ckpt["model"]["layers.1.ffn_norm.weight"]
+        with pytest.raises(KeyError, match="ffn_norm"):
+            state_from_torch_ckpt(ckpt, model, opt, jnp.float32)
+    else:
+        ckpt["optimizer"]["state"].pop(0)
+        with pytest.raises(ValueError, match="param indices"):
+            state_from_torch_ckpt(ckpt, model, opt, jnp.float32)
+
+
+def _convert(cmd, tmp_path, **flags):
+    argv = [sys.executable, str(REPO / "scripts" / "convert_checkpoint.py"),
+            cmd, "--model", "tiny", "--vocab-size", "259",
+            "--sequence-length", "128", "--learning-rate", "1e-3",
+            "--lr-warmup-steps", "5"]
+    for k, v in flags.items():
+        argv += [k, str(v)]
+    r = subprocess.run(argv, capture_output=True, text=True, env=_env(),
+                       timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_cli_end_to_end_torch_round_trip(tmp_path, parquet):
+    """train 10 steps -> Orbax ckpt -> torch .ckpt -> Orbax ckpt -> resume:
+    the resumed run's losses are bit-exact with an uninterrupted run."""
+    torch = pytest.importorskip("torch")
+    ckpts = tmp_path / "ckpts"
+    base_args = {"--checkpoint-path": str(ckpts), "--learning-rate": "1e-3",
+                 "--lr-warmup-steps": "5"}
+    # uninterrupted 20-step baseline
+    rc, baseline = _run(_args(tmp_path, parquet, **dict(
+        base_args, **{"--training-steps": 20})), job_id="cv_base")
+    assert rc == 0, baseline
+    # 10-step run that checkpoints at step 10
+    rc, out = _run(_args(tmp_path, parquet, **dict(
+        base_args, **{"--training-steps": 10,
+                      "--checkpoint-frequency": 10})), job_id="cv1")
+    assert rc == 0, out
+
+    torch_file = tmp_path / "checkpoint_cv1.ckpt"
+    _convert("to-torch", tmp_path, **{"--checkpoint-path": ckpts,
+                                      "--job-id": "cv1",
+                                      "--output": torch_file})
+    ckpt = torch.load(torch_file, map_location="cpu", weights_only=False)
+    assert ckpt["training_step"] == 10
+    assert set(ckpt) == {"model", "optimizer", "lr_scheduler",
+                         "training_step"}  # ref utils.py:75-80
+    assert ckpt["model"]["tok_embeddings.weight"].dtype == torch.bfloat16
+    assert "lr_lambdas" in ckpt["lr_scheduler"]  # LambdaLR schema
+
+    _convert("to-tpu", tmp_path, **{"--input": torch_file,
+                                    "--checkpoint-path": ckpts,
+                                    "--job-id": "cv2", "--batch-size": 2})
+    rc, resumed = _run(_args(tmp_path, parquet, **dict(
+        base_args, **{"--training-steps": 20,
+                      "--checkpoint-id": "cv2"})), job_id="cv3")
+    assert rc == 0, resumed
+    assert "Resuming training from training_step 10" in resumed
+    base_losses = _losses_by_step(baseline)
+    res_losses = _losses_by_step(resumed)
+    steps = [str(s) for s in range(11, 20)]
+    assert all(s in res_losses for s in steps), resumed
+    assert [res_losses[s] for s in steps] == [base_losses[s] for s in steps]
