@@ -40,7 +40,8 @@ def main():
     warmup = 5 if on_tpu else 1
 
     cfg = get_config("gpt2-125m", vocab_size=50257, seq_len=seq,
-                     attention_impl=os.environ.get("BENCH_ATTN", "auto"))
+                     attention_impl=os.environ.get("BENCH_ATTN", "auto"),
+                     layer_impl=os.environ.get("BENCH_LAYER_IMPL", "loop"))
     mesh = make_mesh()  # all local devices on the data axis
     n_chips = len(mesh.devices.flatten())
 

@@ -112,9 +112,15 @@ def state_to_torch_ckpt(state, n_layers: int, learning_rate: float,
     the same schedule the trainer uses (utils/schedules.py)."""
     from ..utils.schedules import linear_warmup_constant
 
+    from ..models.llama import unstack_layer_params
+
     step = int(np.asarray(state.step))
     current_lr = float(linear_warmup_constant(learning_rate,
                                               warmup_steps)(step))
+    # scan-form states (layer_impl="scan": layers/block/... with a leading
+    # n_layers axis) export through the loop layout the reference uses
+    maybe_unstack = (lambda t: unstack_layer_params(t, n_layers)
+                     if "layers" in t else t)
     adams = [s for s in jax.tree_util.tree_leaves(
         state.opt_state,
         is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))
@@ -123,8 +129,8 @@ def state_to_torch_ckpt(state, n_layers: int, learning_rate: float,
         raise ValueError("opt_state holds no ScaleByAdamState; only AdamW "
                          "states convert to the reference format")
     adam = adams[0]
-    mu = _to_torch_orientation(adam.mu, n_layers)
-    nu = _to_torch_orientation(adam.nu, n_layers)
+    mu = _to_torch_orientation(maybe_unstack(adam.mu), n_layers)
+    nu = _to_torch_orientation(maybe_unstack(adam.nu), n_layers)
     names = [n for n, _, _ in reference_param_names(n_layers)]
     opt_state = {
         i: {"step": np.float32(step), "exp_avg": mu[name],
@@ -132,7 +138,7 @@ def state_to_torch_ckpt(state, n_layers: int, learning_rate: float,
         for i, name in enumerate(names)
     }
     return {
-        "model": _to_torch_orientation(state.params, n_layers),
+        "model": _to_torch_orientation(maybe_unstack(state.params), n_layers),
         "optimizer": {
             "state": opt_state,
             "param_groups": [{
@@ -156,14 +162,20 @@ def state_from_torch_ckpt(ckpt: dict, model, optimizer, param_dtype):
 
     ``model``/``optimizer`` are this framework's Transformer and optax
     transform — the optimizer is initialized for structure, then the Adam
-    moments and every update count are replaced from the checkpoint."""
+    moments and every update count are replaced from the checkpoint. When
+    the model is scan-form (layer_impl="scan"), the imported trees are
+    layer-stacked to match."""
+    from ..models.llama import stack_layer_params
     from ..training.state import TrainState
 
     n_layers = model.cfg.n_layers
     step = int(ckpt["training_step"])
+    maybe_stack = (lambda t: stack_layer_params(t, n_layers)
+                   if model.cfg.layer_impl == "scan" else t)
     cast = lambda t: jax.tree_util.tree_map(  # noqa: E731
         lambda a: jnp.asarray(a, param_dtype), t)
-    params = cast(_from_torch_orientation(ckpt["model"], n_layers))
+    params = cast(maybe_stack(_from_torch_orientation(ckpt["model"],
+                                                      n_layers)))
 
     names = [n for n, _, _ in reference_param_names(n_layers)]
     # normalize: torch state keys may round-trip as strings (e.g. JSON)
@@ -176,8 +188,8 @@ def state_from_torch_ckpt(ckpt: dict, model, optimizer, param_dtype):
              for i, name in enumerate(names)}
     nu_sd = {name: np.asarray(opt[i]["exp_avg_sq"])
              for i, name in enumerate(names)}
-    mu = cast(_from_torch_orientation(mu_sd, n_layers))
-    nu = cast(_from_torch_orientation(nu_sd, n_layers))
+    mu = cast(maybe_stack(_from_torch_orientation(mu_sd, n_layers)))
+    nu = cast(maybe_stack(_from_torch_orientation(nu_sd, n_layers)))
 
     opt_state = optimizer.init(params)
     count = jnp.asarray(step, jnp.int32)

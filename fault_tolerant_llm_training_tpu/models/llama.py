@@ -151,9 +151,33 @@ class TransformerBlock(nn.Module):
         return constrain(out, "batch", "seq", "act_embed")
 
 
+class _ScanBlock(nn.Module):
+    """Scan adapter: gives TransformerBlock the (carry, x) -> (carry, y)
+    shape ``nn.scan`` requires; params nest one level deeper
+    (``layers/block/...`` with a leading n_layers axis)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        block = TransformerBlock
+        if self.cfg.remat:
+            # prevent_cse=False: the scan's while loop already prevents
+            # cross-iteration CSE, so the extra optimization barriers the
+            # default inserts would only block in-body fusion
+            block = nn.remat(TransformerBlock, prevent_cse=False,
+                             static_argnums=())
+        return block(self.cfg, name="block")(x, positions), None
+
+
 class Transformer(nn.Module):
     """Trunk: embed -> n_layers blocks -> final norm -> untied head
-    (ref: model.py:315-380)."""
+    (ref: model.py:315-380).
+
+    The reference's 32 distinct ``ModuleDict`` blocks (model.py:346-348)
+    map to ``layer_impl="loop"``; ``"scan"`` is the TPU-idiomatic form —
+    one block body compiled once by XLA and scanned over layer-stacked
+    params, so compile time stops growing with depth."""
 
     cfg: TransformerConfig
 
@@ -162,13 +186,50 @@ class Transformer(nn.Module):
         cfg = self.cfg
         x = TokenEmbed(cfg, name="tok_embeddings")(tokens)
         x = constrain(x, "batch", "seq", "act_embed")
-        block = TransformerBlock
-        if cfg.remat:
-            block = nn.remat(TransformerBlock, static_argnums=())
-        for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layers_{i}")(x, positions)
+        if cfg.layer_impl == "scan":
+            if positions is None:
+                # scan broadcasts positions to the body; materialize the
+                # default prefix positions (same cos/sin values as the
+                # precomputed-table path in Attention) at (1, S) — the
+                # rope cos/sin shapes then broadcast over batch instead of
+                # replicating B-fold inside the loop body
+                positions = jnp.arange(tokens.shape[1],
+                                       dtype=jnp.int32)[None, :]
+            scan = nn.scan(
+                _ScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                in_axes=nn.broadcast,
+            )
+            x, _ = scan(cfg, name="layers")(x, positions)
+        else:
+            block = TransformerBlock
+            if cfg.remat:
+                block = nn.remat(TransformerBlock, static_argnums=())
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layers_{i}")(x, positions)
         x = RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, kernel_init=_DENSE_INIT,
                           name="output")(x)
         return constrain(logits, "batch", "seq", "vocab")
+
+
+def stack_layer_params(params: dict, n_layers: int) -> dict:
+    """Convert a loop-form param tree (``layers_{i}/...``) to the scan form
+    (``layers/block/...`` leaves with a leading n_layers axis)."""
+    layers = [params[f"layers_{i}"] for i in range(n_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    out = {k: v for k, v in params.items() if not k.startswith("layers_")}
+    out["layers"] = {"block": stacked}
+    return out
+
+
+def unstack_layer_params(params: dict, n_layers: int) -> dict:
+    """Inverse of :func:`stack_layer_params`."""
+    stacked = params["layers"]["block"]
+    out = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(n_layers):
+        out[f"layers_{i}"] = jax.tree_util.tree_map(lambda a: a[i], stacked)
+    return out

@@ -41,6 +41,9 @@ LOGICAL_RULES: Dict[str, object] = {
     "kv_heads": "tensor",
     "mlp": "tensor",
     "norm": None,
+    # leading layer-stack axis of scan-form params (models/llama.py
+    # layer_impl="scan"); reserved for a future pipeline axis
+    "layers": None,
 }
 
 # Parameter-path (joined with '/') -> logical axes of that parameter.
@@ -91,6 +94,10 @@ def param_pspecs(params) -> dict:
     def spec_for(path: str, leaf) -> P:
         for pattern, axes in PARAM_AXIS_RULES:
             if re.search(pattern, path):
+                if (re.search(r"(^|/)layers/block/", path)
+                        and leaf.ndim == len(axes) + 1):
+                    # scan-form params carry a leading layer-stack axis
+                    axes = ("layers",) + tuple(axes)
                 if len(axes) != leaf.ndim:
                     raise ValueError(
                         f"rule {pattern!r} gives {len(axes)} axes for {path} "

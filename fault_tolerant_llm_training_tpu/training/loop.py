@@ -152,7 +152,8 @@ class Trainer:
             cfg.model, vocab_size=vocab, seq_len=cfg.sequence_length,
             dtype=dtype, param_dtype=param_dtype,
             attention_impl=cfg.attention_impl, embed_impl=cfg.embed_impl,
-            sp_layout=cfg.sp_layout, remat=cfg.remat)
+            sp_layout=cfg.sp_layout, layer_impl=cfg.layer_impl,
+            remat=cfg.remat)
         self.model = Transformer(self.model_config)
         self.optimizer = make_optimizer(cfg.learning_rate, cfg.lr_warmup_steps)
 

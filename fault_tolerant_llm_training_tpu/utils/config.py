@@ -52,6 +52,7 @@ class TrainConfig:
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     sp_layout: str = "zigzag"  # zigzag (causal-balanced ring) | contiguous
     embed_impl: str = "auto"  # auto | gather | one_hot (one_hot: TP-friendly)
+    layer_impl: str = "loop"  # loop | scan (scan: O(1) compile time in depth)
     remat: bool = False  # jax.checkpoint each block (trade FLOPs for HBM)
     master_weights: str = "same"  # same | fp32 (fp32 optimizer master copy)
     data_loading: str = "map"  # map (ParquetDataset path) | packed (iterable)
@@ -147,6 +148,11 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Token-embedding lookup; one_hot contracts a "
                              "vocab-sharded table on the MXU (auto: one_hot "
                              "iff tensor-parallel)")
+    parser.add_argument("--layer-impl", type=str, default="loop",
+                        choices=["loop", "scan"],
+                        help="Trunk form: loop unrolls each block; scan "
+                             "compiles one block body over layer-stacked "
+                             "params (O(1) compile time in depth)")
     parser.add_argument("--remat", action="store_true",
                         help="Rematerialize each transformer block (saves HBM)")
     parser.add_argument("--master-weights", type=str, default="same",

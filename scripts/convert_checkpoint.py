@@ -42,6 +42,12 @@ def main(argv=None):
         s.add_argument("--vocab-size", type=int, required=True)
         s.add_argument("--sequence-length", type=int,
                        default=common["sequence_length"])
+        s.add_argument("--layer-impl", type=str, default="loop",
+                       choices=["loop", "scan"],
+                       help="Trunk form of the TPU-side checkpoint (must "
+                            "match the --layer-impl it was/will be trained "
+                            "with); the torch side is always the "
+                            "reference's per-layer layout")
         s.add_argument("--learning-rate", type=float, default=1e-5)
         s.add_argument("--lr-warmup-steps", type=int, default=10)
         s.add_argument("--checkpoint-path", type=str, required=True,
@@ -99,7 +105,8 @@ def main(argv=None):
         return torch.from_numpy(a)
 
     cfg = get_config(args.model, vocab_size=args.vocab_size,
-                     seq_len=args.sequence_length)
+                     seq_len=args.sequence_length,
+                     layer_impl=args.layer_impl)
     model = Transformer(cfg)
     optimizer = make_optimizer(args.learning_rate, args.lr_warmup_steps)
     mngr = CheckpointManager(args.checkpoint_path, args.job_id,

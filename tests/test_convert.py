@@ -99,6 +99,36 @@ def test_export_carries_warmup_scaled_lr():
     assert ckpt["optimizer"]["param_groups"][0]["lr"] == pytest.approx(1e-3)
 
 
+def test_scan_form_state_round_trips_through_torch_format():
+    """A scan-trained state (layer-stacked params) exports through the
+    reference's per-layer layout and re-imports into either trunk form."""
+    from fault_tolerant_llm_training_tpu.models.llama import (
+        stack_layer_params,
+    )
+
+    cfg, loop_model, opt, loop_state, _ = _trained_state(n_steps=2)
+    scan_model = Transformer(cfg.replace(layer_impl="scan"))
+    scan_state = loop_state.replace(
+        params=stack_layer_params(loop_state.params, cfg.n_layers),
+        opt_state=(
+            loop_state.opt_state[0]._replace(
+                mu=stack_layer_params(loop_state.opt_state[0].mu,
+                                      cfg.n_layers),
+                nu=stack_layer_params(loop_state.opt_state[0].nu,
+                                      cfg.n_layers)),
+        ) + loop_state.opt_state[1:])
+    # scan export == loop export, key for key
+    a = state_to_torch_ckpt(scan_state, cfg.n_layers, 1e-3)
+    b = state_to_torch_ckpt(loop_state, cfg.n_layers, 1e-3)
+    for k in b["model"]:
+        np.testing.assert_array_equal(a["model"][k], b["model"][k])
+    # import back as scan: matches the original scan state exactly
+    back = state_from_torch_ckpt(a, scan_model, opt, jnp.float32)
+    for x, y in zip(jax.tree_util.tree_leaves(scan_state),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
 def test_string_keyed_optimizer_state_accepted():
     """torch state keys may round-trip as strings (e.g. via JSON)."""
     cfg, model, opt, state, _ = _trained_state(n_steps=2)

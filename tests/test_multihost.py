@@ -202,15 +202,21 @@ def test_two_process_usr1_chain_and_resume(tmp_path, parquet2):
         assert "Training completed" in o
 
 
-def test_two_process_periodic_checkpointing(tmp_path, parquet2):
+def test_two_process_periodic_checkpointing_and_eval(tmp_path, parquet2):
     """Periodic coordinated saves on a pod: the pre-save barrier runs with
     the dispatch pipeline drained (regression: entering the barrier with
     steps in flight interleaves collectives differently per host and
-    crashes gloo), and both hosts finish with the checkpoints on disk."""
+    crashes gloo), and both hosts finish with the checkpoints on disk.
+    Held-out eval runs on the same cluster: every host dispatches the same
+    eval program order (no cross-host divergence) and reports the same
+    token-weighted loss."""
+    import re
+
     ckpt = str(tmp_path / "ckpts")
     rcs, outs = _launch_pair(
         ["--dataset", parquet2, "--checkpoint-path", ckpt,
-         "--training-steps", "12", "--checkpoint-frequency", "4"],
+         "--training-steps", "12", "--checkpoint-frequency", "4",
+         "--eval-frequency", "6", "--eval-batches", "2"],
         job_id="mh_per")
     assert rcs == [0, 0], outs
     for o in outs:
@@ -218,6 +224,10 @@ def test_two_process_periodic_checkpointing(tmp_path, parquet2):
     root = tmp_path / "ckpts" / "checkpoint_mh_per"
     steps = sorted(int(p.name) for p in root.iterdir() if p.name.isdigit())
     assert 8 in steps, steps
+    evals = [re.findall(r"Eval \| step (\d+) \| loss ([\d.]+)", o)
+             for o in outs]
+    assert [s for s, _ in evals[0]] == ["6", "12"], outs[0]
+    assert evals[0] == evals[1], "hosts disagree on eval losses"
 
 
 @pytest.fixture(scope="module")
