@@ -177,43 +177,65 @@ class Transformer(nn.Module):
     The reference's 32 distinct ``ModuleDict`` blocks (model.py:346-348)
     map to ``layer_impl="loop"``; ``"scan"`` is the TPU-idiomatic form —
     one block body compiled once by XLA and scanned over layer-stacked
-    params, so compile time stops growing with depth."""
+    params, so compile time stops growing with depth.
+
+    Setup-style (not compact) so the pipeline-parallel step can drive the
+    pieces separately via ``apply(..., method="embed"/"head")`` while
+    ``__call__`` stays the single-call path; attribute names keep the param
+    tree byte-compatible with the compact form (``tok_embeddings``,
+    ``layers_{i}`` / ``layers/block``, ``norm``, ``output``)."""
 
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, tokens, positions=None):
+    def setup(self):
         cfg = self.cfg
-        x = TokenEmbed(cfg, name="tok_embeddings")(tokens)
-        x = constrain(x, "batch", "seq", "act_embed")
+        self.tok_embeddings = TokenEmbed(cfg)
         if cfg.layer_impl == "scan":
-            if positions is None:
-                # scan broadcasts positions to the body; materialize the
-                # default prefix positions (same cos/sin values as the
-                # precomputed-table path in Attention) at (1, S) — the
-                # rope cos/sin shapes then broadcast over batch instead of
-                # replicating B-fold inside the loop body
-                positions = jnp.arange(tokens.shape[1],
-                                       dtype=jnp.int32)[None, :]
-            scan = nn.scan(
+            self.layers = nn.scan(
                 _ScanBlock,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 in_axes=nn.broadcast,
-            )
-            x, _ = scan(cfg, name="layers")(x, positions)
+            )(cfg)
         else:
             block = TransformerBlock
             if cfg.remat:
                 block = nn.remat(TransformerBlock, static_argnums=())
-            for i in range(cfg.n_layers):
-                x = block(cfg, name=f"layers_{i}")(x, positions)
-        x = RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype, name="norm")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          param_dtype=cfg.param_dtype, kernel_init=_DENSE_INIT,
-                          name="output")(x)
+            # a module list attribute named ``layers`` yields param keys
+            # layers_0..layers_{N-1}, matching the reference's ModuleDict
+            self.layers = [block(cfg) for _ in range(cfg.n_layers)]
+        self.norm = RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype)
+        self.output = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               kernel_init=_DENSE_INIT)
+
+    def embed(self, tokens):
+        x = self.tok_embeddings(tokens)
+        return constrain(x, "batch", "seq", "act_embed")
+
+    def head(self, x):
+        x = self.norm(x)
+        logits = self.output(x)
         return constrain(logits, "batch", "seq", "vocab")
+
+    def default_positions(self, seq_len: int):
+        """(1, S) prefix positions — same cos/sin values as the
+        precomputed-table path in Attention, broadcasting over batch."""
+        return jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        x = self.embed(tokens)
+        if cfg.layer_impl == "scan":
+            if positions is None:
+                # scan broadcasts positions to the body; materialize them
+                positions = self.default_positions(tokens.shape[1])
+            x, _ = self.layers(x, positions)
+        else:
+            for layer in self.layers:
+                x = layer(x, positions)
+        return self.head(x)
 
 
 def stack_layer_params(params: dict, n_layers: int) -> dict:

@@ -5,6 +5,8 @@ reference would have needed at scale).
 Mesh axes, in order:
 
 - ``data``     — pure data parallelism (gradient psum over ICI)
+- ``pipe``     — pipeline parallelism (layer-stacked params sharded by
+                 stage; microbatches rotate via ppermute — parallel/pipeline.py)
 - ``fsdp``     — parameter/optimizer sharding; also shards the batch
 - ``sequence`` — sequence/context parallelism (ring attention)
 - ``tensor``   — tensor parallelism (Megatron-style sharded matmuls)
@@ -12,7 +14,8 @@ Mesh axes, in order:
 Collectives are inserted by XLA from the NamedShardings; on a real pod the
 axes should be laid out so that ``tensor``/``sequence`` ride ICI and ``data``
 can span DCN (the axis order here puts the fast-varying axes last, which maps
-them to nearby devices in the default device order).
+them to nearby devices in the default device order; ``pipe`` sits early
+because a stage exchanges only one microbatch activation per tick).
 """
 
 import contextlib
@@ -22,25 +25,28 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "sequence", "tensor")
+MESH_AXES = ("data", "pipe", "fsdp", "sequence", "tensor")
 
 _ACTIVE_MESH: Optional[Mesh] = None
 
 
 def make_mesh(dp: int = -1, fsdp: int = 1, sp: int = 1, tp: int = 1,
-              devices=None) -> Mesh:
-    """Build a ('data','fsdp','sequence','tensor') mesh; dp=-1 fills devices."""
+              pp: int = 1, devices=None) -> Mesh:
+    """Build a ('data','pipe','fsdp','sequence','tensor') mesh; dp=-1 fills
+    the remaining devices."""
     devices = list(jax.devices()) if devices is None else list(devices)
     n = len(devices)
-    denom = fsdp * sp * tp
+    denom = pp * fsdp * sp * tp
     if dp == -1:
         if n % denom:
-            raise ValueError(f"{n} devices not divisible by fsdp*sp*tp={denom}")
+            raise ValueError(
+                f"{n} devices not divisible by pp*fsdp*sp*tp={denom}")
         dp = n // denom
     total = dp * denom
     if total > n:
-        raise ValueError(f"mesh {dp}x{fsdp}x{sp}x{tp}={total} exceeds {n} devices")
-    arr = np.asarray(devices[:total]).reshape(dp, fsdp, sp, tp)
+        raise ValueError(
+            f"mesh {dp}x{pp}x{fsdp}x{sp}x{tp}={total} exceeds {n} devices")
+    arr = np.asarray(devices[:total]).reshape(dp, pp, fsdp, sp, tp)
     return Mesh(arr, MESH_AXES)
 
 

@@ -1,0 +1,135 @@
+"""Pipeline parallelism: GPipe over the mesh's ``pipe`` axis.
+
+No reference counterpart (SURVEY.md §2.3: the reference has no parallelism
+at all) — this is a beyond-parity scale-out path completing the mesh
+portfolio (dp / pp / fsdp / sp / tp). TPU-native design:
+
+- layer-stacked (scan-form) params are sharded over ``pipe`` on their
+  leading layer axis by the path rules (parallel/sharding.py), so stage
+  ``s`` *stores* only layers ``[s*L/P, (s+1)*L/P)`` — the memory win that
+  motivates PP;
+- the trunk runs under a partial-manual ``shard_map`` (``axis_names=
+  {'pipe'}``): the pipe axis is hand-scheduled while data/fsdp/tensor
+  shardings stay with the auto partitioner, so PP composes with DP/FSDP/TP
+  without manual collectives for them;
+- microbatches flow stage-to-stage via ``lax.ppermute`` in a GPipe
+  schedule of ``M + P - 1`` ticks (bubble fraction (P-1)/(M+P-1));
+  autodiff through the schedule yields the reverse pipeline for free;
+- embedding and head run *outside* the shard_map, replicated over ``pipe``
+  by the auto partitioner — redundant FLOPs on P-1 stages, traded for a
+  schedule that needs no stage-conditional branches around the (B, S, V)
+  head matmul.
+
+The jitted result computes exactly the same function as the plain trunk
+(tests/test_pipeline.py pins loss equivalence on the CPU mesh).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import active_mesh
+
+
+def pipeline_hidden(model, params, x, positions, mesh=None,
+                    microbatches: int = 0) -> jax.Array:
+    """Run the scan-form trunk through the GPipe schedule.
+
+    ``x``: (B, S, D) embedded activations (global view); returns the final
+    hidden states (B, S, D). Caller applies embed before and head after.
+    """
+    from ..models.llama import TransformerBlock
+
+    mesh = mesh or active_mesh()
+    pp = mesh.shape["pipe"]
+    n_micro = microbatches or pp
+    cfg = model.cfg
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp {pp}")
+    if x.shape[0] % n_micro:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by microbatches {n_micro}")
+
+    from flax import linen as nn
+
+    block_cls = TransformerBlock
+    if cfg.remat:
+        block_cls = nn.remat(TransformerBlock, prevent_cse=False,
+                             static_argnums=())
+    block = block_cls(cfg)
+    stacked = params["layers"]["block"]
+
+    def local_layers(stack_local, h, pos):
+        def step(c, layer_params):
+            return block.apply({"params": layer_params}, c, pos), None
+        out, _ = jax.lax.scan(step, h, stack_local)
+        return out
+
+    compute_dtype = x.dtype
+
+    def body(stack_local, x, pos):
+        s = jax.lax.axis_index("pipe")
+        # boundary values travel in fp32: the cotangent of a replicated
+        # (P()) shard_map input is accumulated with a psum over 'pipe', and
+        # bf16 psums inside a partial-manual shard_map trip an XLA
+        # partitioner CHECK (jax 0.9 / XLA CPU) — compute stays bf16
+        x = x.astype(compute_dtype)
+        b, seq, d = x.shape
+        mb = b // n_micro
+        micro = x.reshape(n_micro, mb, seq, d)
+        ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+        # One lax.scan over the ticks (not an unrolled Python loop): the
+        # layer scan inside is traced once, keeping compile time O(1) in
+        # microbatches — the same reason the trunk itself is scanned.
+        def tick(carry, t):
+            buf, recv = carry
+            inject = jnp.where(
+                t < n_micro,
+                jax.lax.dynamic_index_in_dim(
+                    micro, jnp.minimum(t, n_micro - 1), 0, keepdims=False),
+                jnp.zeros((mb, seq, d), x.dtype))
+            xin = jnp.where(s == 0, inject, recv)
+            out = local_layers(stack_local, xin, pos)
+            recv = jax.lax.ppermute(out, "pipe", ring)
+            # stage P-1 finished microbatch t-P+1 this tick; earlier ticks
+            # (and other stages, masked below) write a no-op
+            idx = jnp.clip(t - pp + 1, 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+            upd = jnp.where(t >= pp - 1, out, cur)
+            buf = jax.lax.dynamic_update_index_in_dim(buf, upd, idx, 0)
+            return (buf, recv), None
+
+        buf = jnp.zeros_like(micro)
+        recv = jnp.zeros((mb, seq, d), x.dtype)
+        (buf, _), _ = jax.lax.scan(tick, (buf, recv),
+                                   jnp.arange(n_micro + pp - 1))
+        buf = jnp.where(s == pp - 1, buf, jnp.zeros((), x.dtype))
+        # broadcast the last stage's result to every stage; fp32 for the
+        # same partitioner reason as above, and it doubles as the fp32
+        # boundary on the way out
+        buf = jax.lax.psum(buf.astype(jnp.float32), "pipe")
+        return buf.reshape(b, seq, d)
+
+    stack_specs = jax.tree_util.tree_map(
+        lambda leaf: P("pipe"), stacked)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(stack_specs, P(), P()),
+                   out_specs=P(), axis_names={"pipe"}, check_vma=False)
+    from ..parallel.sharding import suspend_constraints
+    with suspend_constraints():
+        # constraints inside the manual region would stamp all-auto-mesh
+        # shardings that break the shard_map transpose (see sharding.py)
+        hidden = fn(stacked, x.astype(jnp.float32), positions)
+    return hidden.astype(x.dtype)
+
+
+def pipeline_apply(model, params, tokens, mesh=None,
+                   microbatches: int = 0) -> jax.Array:
+    """Full forward (embed -> pipelined trunk -> head) -> logits."""
+    x = model.apply({"params": params}, tokens, method="embed")
+    positions = model.default_positions(tokens.shape[1])
+    hidden = pipeline_hidden(model, params, x, positions, mesh=mesh,
+                             microbatches=microbatches)
+    return model.apply({"params": params}, hidden, method="head")

@@ -21,6 +21,7 @@ meshes do both — XLA inserts all-gathers / reduce-scatters / psums from the
 NamedShardings (the scaling-book recipe).
 """
 
+import contextlib
 import re
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -28,6 +29,25 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import active_mesh
+
+_CONSTRAINTS_SUSPENDED = False
+
+
+@contextlib.contextmanager
+def suspend_constraints():
+    """Disable ``constrain`` for the dynamic extent of a trace.
+
+    Needed while tracing the pipeline's partial-manual shard_map body
+    (parallel/pipeline.py): inside ``lax.scan`` the Manual-context query
+    below is unreliable, and a constraint stamped on the all-auto mesh
+    inside the manual region breaks the shard_map transpose."""
+    global _CONSTRAINTS_SUSPENDED
+    prev = _CONSTRAINTS_SUSPENDED
+    _CONSTRAINTS_SUSPENDED = True
+    try:
+        yield
+    finally:
+        _CONSTRAINTS_SUSPENDED = prev
 
 LOGICAL_RULES: Dict[str, object] = {
     "batch": ("data", "fsdp"),
@@ -42,8 +62,10 @@ LOGICAL_RULES: Dict[str, object] = {
     "mlp": "tensor",
     "norm": None,
     # leading layer-stack axis of scan-form params (models/llama.py
-    # layer_impl="scan"); reserved for a future pipeline axis
-    "layers": None,
+    # layer_impl="scan"): sharded by pipeline stage, so each stage stores
+    # only its own layers (parallel/pipeline.py); on meshes without a pipe
+    # axis (size 1) this resolves to replicated
+    "layers": "pipe",
 }
 
 # Parameter-path (joined with '/') -> logical axes of that parameter.
@@ -80,10 +102,22 @@ def constrain(x: jax.Array, *logical_axes) -> jax.Array:
 
     Axes whose mesh axis has size 1 still resolve fine (XLA treats them as
     unsharded), so the same model code traces identically on a laptop CPU and
-    a v5p-64 mesh."""
+    a v5p-64 mesh. Inside a partial-manual ``shard_map`` (the pipeline
+    trunk, parallel/pipeline.py) the constraint must be built on the
+    context's abstract mesh — whose manual axes (e.g. 'pipe') may not be
+    referenced — not on the all-auto concrete mesh."""
     mesh = active_mesh()
-    if mesh is None or len(logical_axes) != x.ndim:
+    if mesh is None or len(logical_axes) != x.ndim or _CONSTRAINTS_SUSPENDED:
         return x
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and getattr(abstract, "shape_tuple", ()):
+        if any(str(kind) == "Manual" for kind in abstract.axis_types):
+            # Inside a partial-manual shard_map (the pipeline trunk,
+            # parallel/pipeline.py) constraints built on the all-auto
+            # concrete mesh clash with the Manual context (and rebuilt ones
+            # still break under autodiff replay); the auto axes' shardings
+            # propagate from the body's inputs, so skip the hint here.
+            return x
     spec = _resolve(logical_axes)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

@@ -101,7 +101,19 @@ class Trainer:
         # checks are local-only and skipped on pods (see _setup_check).
         self._sync_signals = jax.process_count() > 1
 
-        self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp)
+        self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp, pp=cfg.pp)
+        if cfg.pp > 1:
+            if cfg.layer_impl != "scan":
+                raise ValueError(
+                    "--pp needs --layer-impl scan (pipeline stages shard "
+                    "the layer-stacked params; parallel/pipeline.py)")
+            if cfg.sp > 1:
+                raise ValueError("--pp with --sp is not supported")
+            micro = cfg.microbatches or cfg.pp
+            if cfg.batch_size % micro:
+                raise ValueError(
+                    f"--batch-size {cfg.batch_size} not divisible by "
+                    f"microbatches {micro}")
         data_ways = (self.mesh.shape["data"] * self.mesh.shape["fsdp"])
         if cfg.batch_size % data_ways:
             raise ValueError(
@@ -202,7 +214,8 @@ class Trainer:
 
         self.batch_sharding = NamedSharding(self.mesh, batch_pspec())
         self._jit_step = jax.jit(
-            make_train_step(self.model, self.optimizer, cfg.grad_max_norm),
+            make_train_step(self.model, self.optimizer, cfg.grad_max_norm,
+                            microbatches=cfg.microbatches),
             donate_argnums=(0,),
             out_shardings=(self.state_shardings, None))
         # AOT-compile now, inside the signal-deferred setup window: a
@@ -236,7 +249,9 @@ class Trainer:
                 CollatorForCLM(cfg.sequence_length,
                                self.tokenizer.pad_token_id))
             self._eval_batches_cache = None  # tokenized once, first pass
-            self._compiled_eval = jax.jit(make_eval_step(self.model)).lower(
+            self._compiled_eval = jax.jit(
+                make_eval_step(self.model,
+                               microbatches=cfg.microbatches)).lower(
                 self.abstract_state.params, batch_struct,
                 batch_struct).compile()
 

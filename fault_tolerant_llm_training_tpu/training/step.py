@@ -54,14 +54,20 @@ def make_optimizer(learning_rate: float, warmup_steps: int
                        weight_decay=0.01)
 
 
-def model_loss(model, params, inputs, labels
+def model_loss(model, params, inputs, labels, microbatches: int = 0
                ) -> Tuple[jax.Array, jax.Array]:
     """Forward + CE, shared by the train and eval steps (so the sequence-
-    layout handling below can never diverge between them).
+    layout and pipeline handling below can never diverge between them).
 
     Returns (mean loss, num_valid_tokens)."""
     sp = mesh_axis_size("sequence")
     cfg = getattr(model, "cfg", None)
+    if (cfg is not None and cfg.layer_impl == "scan"
+            and mesh_axis_size("pipe") > 1):
+        from ..parallel.pipeline import pipeline_apply
+        logits = pipeline_apply(model, params, inputs,
+                                microbatches=microbatches)
+        return cross_entropy_loss(logits, labels)
     if cfg is not None and zigzag_layout_active(cfg, inputs.shape[1], sp):
         # Zigzag sequence layout (ops/ring_attention.py): permute the
         # token stream once so each sequence shard holds one early + one
@@ -77,7 +83,7 @@ def model_loss(model, params, inputs, labels
     return cross_entropy_loss(logits, labels)
 
 
-def make_eval_step(model):
+def make_eval_step(model, microbatches: int = 0):
     """Forward-only loss for held-out evaluation (no reference counterpart —
     the reference never evaluates; SURVEY.md §5.5 notes loss is its only
     metric). Returns packed (sum_nll, num_valid) as one fp32 array so the
@@ -86,24 +92,27 @@ def make_eval_step(model):
     even when batches carry different pad counts."""
 
     def eval_step(params, inputs, labels):
-        loss, num_valid = model_loss(model, params, inputs, labels)
+        loss, num_valid = model_loss(model, params, inputs, labels,
+                                     microbatches)
         return jnp.stack((loss * num_valid, num_valid.astype(jnp.float32)))
 
     return eval_step
 
 
 def make_train_step(model, optimizer: optax.GradientTransformation,
-                    grad_max_norm: float):
+                    grad_max_norm: float, microbatches: int = 0):
     """Build the pure ``(state, inputs, labels) -> (state, metrics)`` step.
 
     metrics: loss (fp32), grad_norm (fp32; host checks finiteness — the
     torch ``error_if_nonfinite`` raise cannot live inside jit, ref:
     utils.py:61), num_tokens, and packed = stack((loss, grad_norm)) — the
     single leaf the host loop fetches per step (one D2H transfer).
+    ``microbatches`` only matters under pipeline parallelism (0 = one
+    microbatch per stage).
     """
 
     def loss_fn(params, inputs, labels):
-        return model_loss(model, params, inputs, labels)
+        return model_loss(model, params, inputs, labels, microbatches)
 
     def train_step(state: TrainState, inputs: jax.Array, labels: jax.Array):
         (loss, num_tokens), grads = jax.value_and_grad(loss_fn, has_aux=True)(

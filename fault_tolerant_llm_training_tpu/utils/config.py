@@ -49,6 +49,8 @@ class TrainConfig:
     fsdp: int = 1  # FSDP (param/optimizer sharding) mesh size
     tp: int = 1  # tensor-parallel mesh size
     sp: int = 1  # sequence-parallel (ring attention) mesh size
+    pp: int = 1  # pipeline-parallel mesh size (needs --layer-impl scan)
+    microbatches: int = 0  # GPipe microbatches (0 = one per pipeline stage)
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     sp_layout: str = "zigzag"  # zigzag (causal-balanced ring) | contiguous
     embed_impl: str = "auto"  # auto | gather | one_hot (one_hot: TP-friendly)
@@ -137,6 +139,10 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--fsdp", type=int, default=1, help="FSDP shard size")
     parser.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     parser.add_argument("--sp", type=int, default=1, help="sequence-parallel (ring) size")
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline-parallel size (needs --layer-impl scan)")
+    parser.add_argument("--microbatches", type=int, default=0,
+                        help="GPipe microbatches (0 = one per pipeline stage)")
     parser.add_argument("--attention-impl", type=str, default="auto",
                         choices=["auto", "xla", "pallas", "ring"])
     parser.add_argument("--sp-layout", type=str, default="zigzag",

@@ -113,7 +113,8 @@ def test_scan_params_shard_under_fsdp(eight_devices):
                               jnp.zeros((1, 8), jnp.int32))
     specs = param_pspecs(abstract["params"])
     wq_spec = specs["layers"]["block"]["attention"]["wq"]["kernel"]
-    assert wq_spec == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+    # leading layer axis -> 'pipe' (size 1 here, so effectively replicated)
+    assert wq_spec == jax.sharding.PartitionSpec("pipe", "fsdp", "tensor")
     mesh = make_mesh(dp=1, fsdp=8)
     with use_mesh(mesh):
         params = jax.jit(
