@@ -50,6 +50,12 @@ class TransformerConfig:
     # identical functions; models/llama.py has the param-layout converters.
     layer_impl: str = "loop"
     remat: bool = False
+    # --- Mixture of Experts (models/moe.py; 0 experts = dense reference
+    # FFN). Experts shard over the mesh's 'expert' axis (--ep). ---
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         # Unknown values would otherwise silently select a default branch
@@ -62,6 +68,13 @@ class TransformerConfig:
             if getattr(self, field) not in allowed:
                 raise ValueError(
                     f"{field}={getattr(self, field)!r} not in {allowed}")
+        if self.moe_experts:
+            if not 1 <= self.moe_top_k <= self.moe_experts:
+                raise ValueError(
+                    f"moe_top_k={self.moe_top_k} must be in "
+                    f"[1, moe_experts={self.moe_experts}]")
+            if self.moe_capacity_factor <= 0:
+                raise ValueError("moe_capacity_factor must be positive")
 
     @property
     def kv_heads(self) -> int:
@@ -83,11 +96,14 @@ class TransformerConfig:
         return self.multiple_of * ((hidden + self.multiple_of - 1) // self.multiple_of)
 
     def param_count(self) -> int:
-        """Exact parameter count (untied output head, ref: model.py:350-352)."""
+        """Exact parameter count (untied output head, ref: model.py:350-352).
+        With MoE: E expert FFNs plus the router matrix per block."""
         d, v, h = self.dim, self.vocab_size, self.ffn_hidden_dim
         qkv = d * (self.n_heads * self.head_dim) + 2 * d * (self.kv_heads * self.head_dim)
         attn = qkv + (self.n_heads * self.head_dim) * d
         ffn = 3 * d * h
+        if self.moe_experts:
+            ffn = self.moe_experts * ffn + d * self.moe_experts  # + router
         per_layer = attn + ffn + 2 * d  # two RMSNorm scales per block
         return v * d + self.n_layers * per_layer + d + d * v  # embed + blocks + final norm + head
 
@@ -113,6 +129,12 @@ PRESETS = {
     "tiny": TransformerConfig(
         dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
         multiple_of=32, rope_theta=10000.0, vocab_size=512, seq_len=128,
+    ),
+    # Hermetic MoE shape (models/moe.py): 4 experts, top-2 routing.
+    "tiny-moe": TransformerConfig(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, rope_theta=10000.0, vocab_size=512, seq_len=128,
+        moe_experts=4, moe_top_k=2,
     ),
 }
 

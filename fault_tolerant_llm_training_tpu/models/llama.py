@@ -146,7 +146,12 @@ class TransformerBlock(nn.Module):
             RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
             positions)
         h = constrain(h, "batch", "seq", "act_embed")
-        out = h + FeedForward(cfg, name="feed_forward")(
+        if cfg.moe_experts:
+            from .moe import MoEFeedForward
+            ffn = MoEFeedForward(cfg, name="feed_forward")
+        else:
+            ffn = FeedForward(cfg, name="feed_forward")
+        out = h + ffn(
             RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h))
         return constrain(out, "batch", "seq", "act_embed")
 
@@ -193,7 +198,8 @@ class Transformer(nn.Module):
         if cfg.layer_impl == "scan":
             self.layers = nn.scan(
                 _ScanBlock,
-                variable_axes={"params": 0},
+                # 'losses': per-layer MoE router aux (models/moe.py sow)
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 in_axes=nn.broadcast,

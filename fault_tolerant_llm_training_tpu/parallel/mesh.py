@@ -9,6 +9,8 @@ Mesh axes, in order:
                  stage; microbatches rotate via ppermute — parallel/pipeline.py)
 - ``fsdp``     — parameter/optimizer sharding; also shards the batch
 - ``sequence`` — sequence/context parallelism (ring attention)
+- ``expert``   — expert parallelism (MoE expert FFNs sharded by expert;
+                 XLA inserts the token<->expert all-to-all — models/moe.py)
 - ``tensor``   — tensor parallelism (Megatron-style sharded matmuls)
 
 Collectives are inserted by XLA from the NamedShardings; on a real pod the
@@ -25,28 +27,28 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "pipe", "fsdp", "sequence", "tensor")
+MESH_AXES = ("data", "pipe", "fsdp", "sequence", "expert", "tensor")
 
 _ACTIVE_MESH: Optional[Mesh] = None
 
 
 def make_mesh(dp: int = -1, fsdp: int = 1, sp: int = 1, tp: int = 1,
-              pp: int = 1, devices=None) -> Mesh:
-    """Build a ('data','pipe','fsdp','sequence','tensor') mesh; dp=-1 fills
-    the remaining devices."""
+              pp: int = 1, ep: int = 1, devices=None) -> Mesh:
+    """Build a ('data','pipe','fsdp','sequence','expert','tensor') mesh;
+    dp=-1 fills the remaining devices."""
     devices = list(jax.devices()) if devices is None else list(devices)
     n = len(devices)
-    denom = pp * fsdp * sp * tp
+    denom = pp * fsdp * sp * ep * tp
     if dp == -1:
         if n % denom:
             raise ValueError(
-                f"{n} devices not divisible by pp*fsdp*sp*tp={denom}")
+                f"{n} devices not divisible by pp*fsdp*sp*ep*tp={denom}")
         dp = n // denom
     total = dp * denom
     if total > n:
-        raise ValueError(
-            f"mesh {dp}x{pp}x{fsdp}x{sp}x{tp}={total} exceeds {n} devices")
-    arr = np.asarray(devices[:total]).reshape(dp, pp, fsdp, sp, tp)
+        raise ValueError(f"mesh {dp}x{pp}x{fsdp}x{sp}x{ep}x{tp}={total} "
+                         f"exceeds {n} devices")
+    arr = np.asarray(devices[:total]).reshape(dp, pp, fsdp, sp, ep, tp)
     return Mesh(arr, MESH_AXES)
 
 

@@ -66,6 +66,10 @@ LOGICAL_RULES: Dict[str, object] = {
     # only its own layers (parallel/pipeline.py); on meshes without a pipe
     # axis (size 1) this resolves to replicated
     "layers": "pipe",
+    # leading expert axis of MoE expert stacks and activations
+    # (models/moe.py): each device on the 'expert' axis stores and computes
+    # only its experts; XLA inserts the dispatch/combine all-to-all
+    "expert_stack": "expert",
 }
 
 # Parameter-path (joined with '/') -> logical axes of that parameter.
@@ -79,6 +83,7 @@ PARAM_AXIS_RULES: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = (
     (r"w3/kernel$", ("embed", "mlp")),
     (r"w2/kernel$", ("mlp", "embed")),
     (r"output/kernel$", ("embed", "vocab")),
+    (r"router/kernel$", ("embed", None)),  # MoE router (models/moe.py)
     (r"(scale|norm)[^/]*$", ("norm",)),
 )
 
@@ -128,10 +133,14 @@ def param_pspecs(params) -> dict:
     def spec_for(path: str, leaf) -> P:
         for pattern, axes in PARAM_AXIS_RULES:
             if re.search(pattern, path):
+                axes = tuple(axes)
+                # stacked-param prefixes, outermost first: the scan-form
+                # layer axis, then the MoE expert axis (both optional)
+                if re.search(r"(^|/)experts/", path) and leaf.ndim > len(axes):
+                    axes = ("expert_stack",) + axes
                 if (re.search(r"(^|/)layers/block/", path)
-                        and leaf.ndim == len(axes) + 1):
-                    # scan-form params carry a leading layer-stack axis
-                    axes = ("layers",) + tuple(axes)
+                        and leaf.ndim > len(axes)):
+                    axes = ("layers",) + axes
                 if len(axes) != leaf.ndim:
                     raise ValueError(
                         f"rule {pattern!r} gives {len(axes)} axes for {path} "

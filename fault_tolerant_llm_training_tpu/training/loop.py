@@ -101,7 +101,8 @@ class Trainer:
         # checks are local-only and skipped on pods (see _setup_check).
         self._sync_signals = jax.process_count() > 1
 
-        self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp, pp=cfg.pp)
+        self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp, pp=cfg.pp,
+                              ep=cfg.ep)
         if cfg.pp > 1:
             if cfg.layer_impl != "scan":
                 raise ValueError(
@@ -160,12 +161,28 @@ class Trainer:
         dtype = PRECISION_STR_TO_DTYPE[cfg.model_dtype]
         param_dtype = (jnp.float32 if cfg.master_weights == "fp32" else dtype)
         vocab = cfg.vocab_size or self.tokenizer.vocab_size
+        moe_over = {k: v for k, v in dict(
+            moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_aux_weight=cfg.moe_aux_weight).items() if v is not None}
         self.model_config = get_config(
             cfg.model, vocab_size=vocab, seq_len=cfg.sequence_length,
             dtype=dtype, param_dtype=param_dtype,
             attention_impl=cfg.attention_impl, embed_impl=cfg.embed_impl,
             sp_layout=cfg.sp_layout, layer_impl=cfg.layer_impl,
-            remat=cfg.remat)
+            remat=cfg.remat, **moe_over)
+        if cfg.ep > 1 and not self.model_config.moe_experts:
+            raise ValueError("--ep needs an MoE model (--model tiny-moe or "
+                             "--moe-experts N)")
+        if self.model_config.moe_experts:
+            if cfg.pp > 1:
+                raise ValueError("--pp with an MoE model is not supported "
+                                 "(the pipeline forward drops the router "
+                                 "aux loss)")
+            if self.model_config.moe_experts % max(cfg.ep, 1):
+                raise ValueError(
+                    f"moe_experts {self.model_config.moe_experts} not "
+                    f"divisible by --ep {cfg.ep}")
         self.model = Transformer(self.model_config)
         self.optimizer = make_optimizer(cfg.learning_rate, cfg.lr_warmup_steps)
 

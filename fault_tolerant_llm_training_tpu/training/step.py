@@ -54,20 +54,30 @@ def make_optimizer(learning_rate: float, warmup_steps: int
                        weight_decay=0.01)
 
 
-def model_loss(model, params, inputs, labels, microbatches: int = 0
-               ) -> Tuple[jax.Array, jax.Array]:
+def model_loss(model, params, inputs, labels, microbatches: int = 0,
+               train: bool = True) -> Tuple[jax.Array, jax.Array]:
     """Forward + CE, shared by the train and eval steps (so the sequence-
-    layout and pipeline handling below can never diverge between them).
+    layout, pipeline, and MoE handling below can never diverge between
+    them). With MoE and ``train=True`` the routers' load-balancing aux
+    losses (sown into the 'losses' collection, models/moe.py) are added
+    with weight ``cfg.moe_aux_weight``; eval reports pure CE.
 
     Returns (mean loss, num_valid_tokens)."""
     sp = mesh_axis_size("sequence")
     cfg = getattr(model, "cfg", None)
     if (cfg is not None and cfg.layer_impl == "scan"
             and mesh_axis_size("pipe") > 1):
+        if cfg.moe_experts:
+            # guard at the point of the drop, not only in the Trainer: the
+            # pipelined forward cannot return the routers' sown aux losses
+            raise NotImplementedError(
+                "pipeline parallelism with an MoE model would silently "
+                "drop the router load-balancing loss")
         from ..parallel.pipeline import pipeline_apply
         logits = pipeline_apply(model, params, inputs,
                                 microbatches=microbatches)
         return cross_entropy_loss(logits, labels)
+    args = ()
     if cfg is not None and zigzag_layout_active(cfg, inputs.shape[1], sp):
         # Zigzag sequence layout (ops/ring_attention.py): permute the
         # token stream once so each sequence shard holds one early + one
@@ -76,10 +86,15 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0
         # schedule sees the layout.
         perm = jnp.asarray(zigzag_perm(inputs.shape[1], sp))
         inputs, labels = inputs[:, perm], labels[:, perm]
-        positions = jnp.broadcast_to(perm[None, :], inputs.shape)
-        logits = model.apply({"params": params}, inputs, positions)
-    else:
-        logits = model.apply({"params": params}, inputs)
+        args = (jnp.broadcast_to(perm[None, :], inputs.shape),)
+    if cfg is not None and cfg.moe_experts and train:
+        logits, mutated = model.apply({"params": params}, inputs, *args,
+                                      mutable=["losses"])
+        aux = sum(jnp.sum(leaf) for leaf in
+                  jax.tree_util.tree_leaves(mutated))
+        loss, num_valid = cross_entropy_loss(logits, labels)
+        return loss + cfg.moe_aux_weight * aux, num_valid
+    logits = model.apply({"params": params}, inputs, *args)
     return cross_entropy_loss(logits, labels)
 
 
@@ -93,7 +108,7 @@ def make_eval_step(model, microbatches: int = 0):
 
     def eval_step(params, inputs, labels):
         loss, num_valid = model_loss(model, params, inputs, labels,
-                                     microbatches)
+                                     microbatches, train=False)
         return jnp.stack((loss * num_valid, num_valid.astype(jnp.float32)))
 
     return eval_step

@@ -51,6 +51,12 @@ class TrainConfig:
     sp: int = 1  # sequence-parallel (ring attention) mesh size
     pp: int = 1  # pipeline-parallel mesh size (needs --layer-impl scan)
     microbatches: int = 0  # GPipe microbatches (0 = one per pipeline stage)
+    ep: int = 1  # expert-parallel mesh size (needs an MoE model)
+    # MoE overrides; None = keep the model preset's values
+    moe_experts: Optional[int] = None
+    moe_top_k: Optional[int] = None
+    moe_capacity_factor: Optional[float] = None
+    moe_aux_weight: Optional[float] = None
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     sp_layout: str = "zigzag"  # zigzag (causal-balanced ring) | contiguous
     embed_impl: str = "auto"  # auto | gather | one_hot (one_hot: TP-friendly)
@@ -143,6 +149,16 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="pipeline-parallel size (needs --layer-impl scan)")
     parser.add_argument("--microbatches", type=int, default=0,
                         help="GPipe microbatches (0 = one per pipeline stage)")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel size (needs an MoE model, "
+                             "e.g. --model tiny-moe or --moe-experts N)")
+    parser.add_argument("--moe-experts", type=int, default=None,
+                        help="Mixture-of-Experts expert count (overrides "
+                             "the preset; 0 = dense FFN)")
+    parser.add_argument("--moe-top-k", type=int, default=None)
+    parser.add_argument("--moe-capacity-factor", type=float, default=None)
+    parser.add_argument("--moe-aux-weight", type=float, default=None,
+                        help="weight of the router load-balancing loss")
     parser.add_argument("--attention-impl", type=str, default="auto",
                         choices=["auto", "xla", "pallas", "ring"])
     parser.add_argument("--sp-layout", type=str, default="zigzag",

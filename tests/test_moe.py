@@ -1,0 +1,195 @@
+"""Mixture-of-Experts (models/moe.py): routing math, dense-FFN equivalence,
+aux loss, expert-parallel sharding, and the full train step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from fault_tolerant_llm_training_tpu.models import Transformer, get_config
+from fault_tolerant_llm_training_tpu.models.llama import FeedForward
+from fault_tolerant_llm_training_tpu.models.moe import MoEFeedForward
+from fault_tolerant_llm_training_tpu.parallel.mesh import make_mesh, use_mesh
+from fault_tolerant_llm_training_tpu.parallel.sharding import (
+    batch_pspec,
+    param_pspecs,
+)
+from fault_tolerant_llm_training_tpu.training.state import TrainState
+from fault_tolerant_llm_training_tpu.training.step import (
+    make_optimizer,
+    make_train_step,
+)
+
+FP32 = dict(dtype=jnp.float32, param_dtype=jnp.float32, attention_impl="xla")
+
+
+def _x(b=2, s=16, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((b, s, d)), jnp.float32)
+
+
+def test_single_expert_equals_dense_ffn():
+    """E=1, k=1, ample capacity: every token routes to the one expert with
+    weight 1.0, so MoE(x) == FFN(x) with the same weights."""
+    cfg = get_config("tiny-moe", moe_experts=1, moe_top_k=1,
+                     moe_capacity_factor=2.0, **FP32)
+    x = _x()
+    moe = MoEFeedForward(cfg)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    got = moe.apply({"params": params}, x)
+    dense_params = jax.tree_util.tree_map(lambda a: a[0],
+                                          params["experts"])
+    want = FeedForward(cfg).apply({"params": dense_params}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_matches_per_token_reference():
+    """With capacity >= every token (no drops), the dispatch/combine einsum
+    formulation equals the direct per-token mixture sum_k w_k * FFN_{e_k}(x)."""
+    cfg = get_config("tiny-moe", moe_capacity_factor=8.0, **FP32)
+    x = _x(seed=3)
+    moe = MoEFeedForward(cfg)
+    params = moe.init(jax.random.PRNGKey(1), x)["params"]
+    got = np.asarray(moe.apply({"params": params}, x))
+
+    b, s, d = x.shape
+    xf = np.asarray(x).reshape(-1, d)
+    gates = xf @ np.asarray(params["router"]["kernel"], np.float32)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(gates), axis=-1))
+    want = np.zeros_like(xf)
+    for i in range(xf.shape[0]):
+        top = np.argsort(-probs[i])[: cfg.moe_top_k]
+        w = probs[i][top] / probs[i][top].sum()
+        for e, wi in zip(top, w):
+            ep = jax.tree_util.tree_map(lambda a: a[e], params["experts"])
+            y = FeedForward(cfg).apply({"params": ep},
+                                       jnp.asarray(xf[i][None, None, :]))
+            want[i] += wi * np.asarray(y)[0, 0]
+    np.testing.assert_allclose(got.reshape(-1, d), want, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_capacity_drops_overflow_tokens():
+    """capacity 1, one slot per token: at most E tokens (the first in token
+    order per expert) can produce output; every overflow token falls back
+    to zero (the residual stream carries it — Switch semantics)."""
+    cfg = get_config("tiny-moe", moe_experts=2, moe_top_k=1,
+                     moe_capacity_factor=1e-9, **FP32)  # capacity -> 1
+    x = _x(b=1, s=8, seed=7)
+    moe = MoEFeedForward(cfg)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    out = np.asarray(moe.apply({"params": params}, x))[0]
+    nonzero = np.flatnonzero(np.abs(out).sum(-1) > 0)
+    assert 1 <= len(nonzero) <= cfg.moe_experts, nonzero
+    # the kept token for each expert is the FIRST (token-order priority):
+    # recompute the routing on the host and check
+    gates = np.asarray(x)[0] @ np.asarray(params["router"]["kernel"],
+                                          np.float32)
+    first_per_expert = {}
+    for i, e in enumerate(np.argmax(gates, axis=-1)):
+        first_per_expert.setdefault(int(e), i)
+    assert sorted(first_per_expert.values()) == sorted(nonzero.tolist())
+
+
+def test_aux_loss_formula_and_sow():
+    cfg = get_config("tiny-moe", **FP32)
+    x = _x(seed=5)
+    moe = MoEFeedForward(cfg)
+    params = moe.init(jax.random.PRNGKey(2), x)["params"]
+    _, mut = moe.apply({"params": params}, x, mutable=["losses"])
+    aux = float(jax.tree_util.tree_leaves(mut)[0])
+    # perfectly balanced routing gives exactly 1.0; anything real is >= 1
+    assert 0.99 <= aux < cfg.moe_experts, aux
+
+
+def test_param_count_matches_init():
+    cfg = get_config("tiny-moe", **FP32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    actual = sum(int(np.prod(p.shape))
+                 for p in jax.tree_util.tree_leaves(params))
+    assert actual == cfg.param_count(), (actual, cfg.param_count())
+
+
+def _run_steps(cfg, mesh_kwargs, n_steps=3):
+    model = Transformer(cfg)
+    opt = make_optimizer(1e-3, warmup_steps=2)
+    mesh = make_mesh(**mesh_kwargs)
+    with use_mesh(mesh):
+        def init_fn(key):
+            params = model.init(key, jnp.zeros((1, 32), jnp.int32))["params"]
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt.init(params))
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        specs = param_pspecs(abstract)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        state = jax.jit(init_fn, out_shardings=shardings)(
+            jax.random.PRNGKey(0))
+        step_fn = jax.jit(make_train_step(model, opt, 1.0),
+                          out_shardings=(shardings, None))
+        rng = np.random.default_rng(11)
+        bsh = NamedSharding(mesh, batch_pspec())
+        losses = []
+        for _ in range(n_steps):
+            toks = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+            labels = np.concatenate(
+                [toks[:, 1:], np.full((8, 1), -100, np.int32)], axis=1)
+            state, metrics = step_fn(state, jax.device_put(toks, bsh),
+                                     jax.device_put(labels, bsh))
+            losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+def test_ep_matches_single_device(eight_devices):
+    """Expert-parallel training (experts sharded over 'expert', all-to-all
+    from the shardings) reproduces the single-device loss trajectory."""
+    cfg = get_config("tiny-moe", **FP32)
+    base, _ = _run_steps(cfg, dict(dp=1, devices=[jax.devices()[0]]))
+    ep, state = _run_steps(cfg, dict(dp=2, ep=4))
+    np.testing.assert_allclose(base, ep, rtol=5e-5, atol=1e-6)
+    # experts actually shard: leading E axis split over the expert axis
+    w1 = state.params["layers_0"]["feed_forward"]["experts"]["w1"]["kernel"]
+    assert w1.sharding.shard_shape(w1.shape)[0] == cfg.moe_experts // 4
+
+
+def test_moe_scan_trunk_matches_loop():
+    """The scanned trunk stacks the per-layer router aux losses (the
+    'losses' collection scans with the layers); one train step from
+    identical weights matches the loop form."""
+    from fault_tolerant_llm_training_tpu.models.llama import (
+        stack_layer_params,
+    )
+
+    cfg = get_config("tiny-moe", **FP32)
+    loop_model = Transformer(cfg)
+    scan_model = Transformer(cfg.replace(layer_impl="scan"))
+    opt = make_optimizer(1e-3, warmup_steps=2)
+    rng = np.random.default_rng(13)
+    toks = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    labels = np.concatenate(
+        [toks[:, 1:], np.full((4, 1), -100, np.int32)], axis=1)
+    params = loop_model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 32), jnp.int32))["params"]
+
+    def one_step(model, p):
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                           opt_state=opt.init(p))
+        step_fn = jax.jit(make_train_step(model, opt, 1.0))
+        _, m = step_fn(state, jnp.asarray(toks), jnp.asarray(labels))
+        return np.asarray(m["packed"])
+
+    a = one_step(loop_model, params)
+    b = one_step(scan_model, stack_layer_params(params, cfg.n_layers))
+    np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-6)
+
+
+def test_moe_preset_validation():
+    with pytest.raises(ValueError, match="moe_top_k"):
+        get_config("tiny-moe", moe_top_k=9)
