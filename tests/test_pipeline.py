@@ -22,6 +22,8 @@ from fault_tolerant_llm_training_tpu.training.step import (
     make_train_step,
 )
 
+from test_fault_tolerance import parquet  # noqa: F401  (shared fixture)
+
 FP32 = dict(dtype=jnp.float32, param_dtype=jnp.float32, attention_impl="xla",
             layer_impl="scan")
 
@@ -117,6 +119,32 @@ def test_pipeline_params_shard_by_stage(eight_devices):
         NamedSharding(mesh, wq_spec))
     shard = sharded.sharding.shard_shape(sharded.shape)
     assert shard[0] == cfg.n_layers // 2  # one layer per stage at pp=2
+
+
+def test_pipeline_checkpoint_resumes_on_non_pipelined_mesh(tmp_path,
+                                                           parquet):
+    """Cross-topology resume across the pipe axis (SURVEY.md §7.3 hard
+    part 3 extended): a checkpoint saved by a dp=2 x pp=2 x fsdp=2 run
+    (stage-sharded layer stacks) resumes on a dp=2 x fsdp=4 mesh with a
+    continuous loss trajectory."""
+    from test_fault_tolerance import _args, _run
+
+    common = {"--batch-size": "8", "--layer-impl": "scan",
+              "--learning-rate": "1e-3", "--lr-warmup-steps": "5"}
+    argv = _args(tmp_path, parquet, **dict(
+        common, **{"--dp": "2", "--pp": "2", "--fsdp": "2",
+                   "--microbatches": "4", "--raise-error": "",
+                   "--error-step": "10"}))
+    rc, out = _run(argv, job_id="ppx1", xla_devices=8)
+    assert rc == 0, out
+    assert "Checkpoint saved at step" in out
+
+    argv = _args(tmp_path, parquet, **dict(
+        common, **{"--checkpoint-id": "ppx1", "--dp": "2", "--fsdp": "4"}))
+    rc, out2 = _run(argv, job_id="ppx2", xla_devices=8)
+    assert rc == 0, out2
+    assert "Resuming training from training_step 11" in out2
+    assert "Training completed" in out2
 
 
 def test_pipeline_requires_divisible_layers(eight_devices):
