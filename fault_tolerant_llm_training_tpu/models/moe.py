@@ -7,16 +7,20 @@ Switch/GShard dense-dispatch formulation rather than gather/scatter:
 
 - a top-k softmax router (fp32) picks experts per token; weights of the
   kept slots are renormalized to sum to 1;
-- tokens are placed into per-expert capacity slots ``C = ceil(cf * k * N /
-  E)`` by a cumulative-count in token order (slot-major priority: all
-  slot-0 assignments outrank slot-1); overflow tokens are *dropped* for
-  that slot (standard Switch semantics — the residual stream still carries
-  them);
-- dispatch/combine are (N, E, C) one-hot einsums, so expert inputs
-  ``(E, C, D)`` and outputs are plain MXU matmuls with static shapes; when
-  the ``expert`` mesh axis is >1, XLA inserts the token->expert all-to-all
-  from the shardings (the experts' stacked params shard over ``expert`` on
-  their leading axis via the path rules, parallel/sharding.py);
+- routing is *grouped* by batch row (GShard's groups): each row places its
+  tokens into per-expert capacity slots ``C = ceil(cf * k * S / E)`` by a
+  cumulative count in token order (slot-major priority: all slot-0
+  assignments outrank slot-1); overflow tokens are *dropped* for that slot
+  (standard Switch semantics — the residual stream still carries them).
+  Grouping bounds the dispatch one-hots at (B, S, E, C) instead of a
+  global (B*S, E, C) — the difference between ~300 MB and ~10 GB at the
+  bench shapes;
+- dispatch/combine are one-hot einsums in the compute dtype, so expert
+  inputs ``(E, B*C, D)`` and outputs are plain MXU matmuls with static
+  shapes; when the ``expert`` mesh axis is >1, XLA inserts the
+  token->expert all-to-all from the shardings (the experts' stacked params
+  shard over ``expert`` on their leading axis via the path rules,
+  parallel/sharding.py);
 - the load-balancing auxiliary loss is the Switch formulation
   ``E * sum_e(f_e * P_e)`` (f = fraction of tokens routed to e at slot 0,
   P = mean router probability), sown into the ``losses`` collection and
@@ -53,50 +57,59 @@ class MoEFeedForward(nn.Module):
         cfg = self.cfg
         E, k = cfg.moe_experts, cfg.moe_top_k
         b, s, d = x.shape
-        n = b * s
-        xf = x.reshape(n, d)
 
         gates = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                          param_dtype=cfg.param_dtype, name="router")(
-            xf.astype(jnp.float32))
-        probs = jax.nn.softmax(gates, axis=-1)  # (N, E), fp32
-        top_w, top_e = jax.lax.top_k(probs, k)  # (N, k)
+            x.astype(jnp.float32))
+        probs = jax.nn.softmax(gates, axis=-1)  # (B, S, E), fp32
+        top_w, top_e = jax.lax.top_k(probs, k)  # (B, S, k)
         top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
 
-        capacity = max(1, math.ceil(cfg.moe_capacity_factor * k * n / E))
-        dispatch = jnp.zeros((n, E, capacity), jnp.float32)
-        combine = jnp.zeros((n, E, capacity), jnp.float32)
-        count = jnp.zeros((E,), jnp.float32)  # filled slots per expert
+        capacity = max(1, math.ceil(cfg.moe_capacity_factor * k * s / E))
+        # dispatch/combine — the two (B, S, E, C) one-hots, by far the
+        # largest tensors here — are built directly in the compute dtype:
+        # every (token, expert) pair is written by at most one slot (top_k
+        # experts are distinct), so no cross-slot add ever rounds. The
+        # position/count bookkeeping stays fp32.
+        dispatch = jnp.zeros((b, s, E, capacity), cfg.dtype)
+        combine = jnp.zeros((b, s, E, capacity), cfg.dtype)
+        count = jnp.zeros((b, E), jnp.float32)  # filled slots per expert
         for slot in range(k):  # k is tiny and static
-            oh = jax.nn.one_hot(top_e[:, slot], E, dtype=jnp.float32)
+            oh = jax.nn.one_hot(top_e[..., slot], E, dtype=jnp.float32)
             # position of each token within its expert's capacity if every
-            # earlier token (and earlier slot) kept its place
-            pos_in_e = (jnp.cumsum(oh, axis=0) - oh) + count[None, :]
-            pos = jnp.sum(pos_in_e * oh, axis=-1)  # (N,)
+            # earlier token (and earlier slot) in its group kept its place
+            pos_in_e = (jnp.cumsum(oh, axis=1) - oh) + count[:, None, :]
+            pos = jnp.sum(pos_in_e * oh, axis=-1)  # (B, S)
             keep = (pos < capacity).astype(jnp.float32)
             pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
                                     dtype=jnp.float32)
-            pair = oh[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
-            dispatch = dispatch + pair
-            combine = combine + pair * top_w[:, slot][:, None, None]
-            count = count + jnp.sum(oh * keep[:, None], axis=0)
+            pair = ((oh * keep[..., None])[..., :, None]
+                    * pos_oh[..., None, :])
+            dispatch = dispatch + pair.astype(cfg.dtype)
+            combine = combine + (
+                pair * top_w[..., slot][..., None, None]).astype(cfg.dtype)
+            count = count + jnp.sum(oh * keep[..., None], axis=1)
 
         # Switch aux loss: E * sum_e f_e * P_e, computed on slot-0 routing
-        f = jnp.mean(jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32),
-                     axis=0)
-        p = jnp.mean(probs, axis=0)
+        # over every token in the batch
+        f = jnp.mean(jax.nn.one_hot(top_e[..., 0], E, dtype=jnp.float32),
+                     axis=(0, 1))
+        p = jnp.mean(probs, axis=(0, 1))
         self.sow("losses", "moe_aux", E * jnp.sum(f * p))
 
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cfg.dtype),
-                               xf)  # (E, C, D)
-        expert_in = constrain(expert_in, "expert_stack", None, "act_embed")
+        # (E, B, C, D): expert axis sharded over 'expert', batch sub-dim
+        # over the batch axes — without the batch constraint every
+        # data-parallel device would all-gather and compute every group
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+        expert_in = constrain(expert_in, "expert_stack", "batch", None,
+                              "act_embed")
         experts = nn.vmap(
             FeedForward,
             variable_axes={"params": 0},
             split_rngs={"params": True},
             in_axes=0, out_axes=0,
         )(cfg, name="experts")
-        expert_out = experts(expert_in)  # (E, C, D)
-        expert_out = constrain(expert_out, "expert_stack", None, "act_embed")
-        y = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
-        return y.reshape(b, s, d)
+        expert_out = experts(expert_in)  # (E, B, C, D)
+        expert_out = constrain(expert_out, "expert_stack", "batch", None,
+                               "act_embed")
+        return jnp.einsum("bsec,ebcd->bsd", combine, expert_out)

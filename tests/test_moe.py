@@ -72,26 +72,28 @@ def test_moe_matches_per_token_reference():
                                atol=2e-4)
 
 
-def test_capacity_drops_overflow_tokens():
-    """capacity 1, one slot per token: at most E tokens (the first in token
-    order per expert) can produce output; every overflow token falls back
-    to zero (the residual stream carries it — Switch semantics)."""
+def test_capacity_drops_overflow_tokens_per_group():
+    """capacity 1 with b=2 rows: the capacity ledger is per batch row
+    (GShard groups) — EACH row keeps its first token per expert, so drops
+    never leak across rows; every overflow token falls back to zero (the
+    residual stream carries it — Switch semantics)."""
     cfg = get_config("tiny-moe", moe_experts=2, moe_top_k=1,
                      moe_capacity_factor=1e-9, **FP32)  # capacity -> 1
-    x = _x(b=1, s=8, seed=7)
+    x = _x(b=2, s=8, seed=7)
     moe = MoEFeedForward(cfg)
     params = moe.init(jax.random.PRNGKey(0), x)["params"]
-    out = np.asarray(moe.apply({"params": params}, x))[0]
-    nonzero = np.flatnonzero(np.abs(out).sum(-1) > 0)
-    assert 1 <= len(nonzero) <= cfg.moe_experts, nonzero
-    # the kept token for each expert is the FIRST (token-order priority):
-    # recompute the routing on the host and check
-    gates = np.asarray(x)[0] @ np.asarray(params["router"]["kernel"],
-                                          np.float32)
-    first_per_expert = {}
-    for i, e in enumerate(np.argmax(gates, axis=-1)):
-        first_per_expert.setdefault(int(e), i)
-    assert sorted(first_per_expert.values()) == sorted(nonzero.tolist())
+    out = np.asarray(moe.apply({"params": params}, x))
+    gates = np.asarray(x) @ np.asarray(params["router"]["kernel"],
+                                       np.float32)
+    for row in range(2):
+        nonzero = np.flatnonzero(np.abs(out[row]).sum(-1) > 0)
+        assert 1 <= len(nonzero) <= cfg.moe_experts, nonzero
+        # the kept token for each expert is the FIRST of THIS row
+        first_per_expert = {}
+        for i, e in enumerate(np.argmax(gates[row], axis=-1)):
+            first_per_expert.setdefault(int(e), i)
+        assert sorted(first_per_expert.values()) == sorted(
+            nonzero.tolist()), row
 
 
 def test_aux_loss_formula_and_sow():
