@@ -1,0 +1,155 @@
+"""Pretokenized token cache for the map-style data path.
+
+The reference tokenizes every document on the fly, per epoch, on the
+training-loop thread (ref: train.py:93 -> dataset.py:29-35); SURVEY.md §7.3
+hard part 5 flags host tokenization as the bottleneck at TPU pod speeds.
+This cache tokenizes the corpus ONCE into a memory-mapped ``(rows,
+seq_len+1)`` int32 array (the exact per-item output of
+``ParquetDataset.__getitem__``), so steady-state data loading becomes a
+memmap row read — no tokenizer on the hot path, and identical batches to
+the uncached path bit-for-bit (tests/test_data.py).
+
+Cache identity: a digest of the resolved shard list (path, size,
+nanosecond mtime), the sequence length, and a fingerprint of the *loaded*
+tokenizer instance (class + vocab/special ids — NOT the requested name:
+``load_tokenizer`` silently falls back to the byte tokenizer offline, and
+a name-keyed cache would then be poisoned for a later online run) — any
+change produces a new cache file, so stale caches are never read. Writes
+are atomic (build to ``.tmp``, then ``os.replace``) and crash-safe (the
+tmp is unlinked on failure; abandoned tmps from killed builders are swept
+after a day). On multi-host pods only process 0 builds; the others poll
+for the finished cache instead of tokenizing the corpus N times.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+CACHE_VERSION = 1
+_STALE_TMP_AGE_S = 86400
+_BUILD_WAIT_TIMEOUT_S = 3600
+
+logger = logging.getLogger()
+
+
+def _tokenizer_fingerprint(tokenizer) -> str:
+    return (f"{type(tokenizer).__name__}"
+            f":v{getattr(tokenizer, 'vocab_size', '?')}"
+            f":p{getattr(tokenizer, 'pad_token_id', '?')}"
+            f":b{getattr(tokenizer, 'bos_token_id', '?')}")
+
+
+class TokenCache:
+    """``tokens[idx]`` -> the padded/truncated input_ids row for ``idx``."""
+
+    def __init__(self, cache_dir: str, source, tokenizer,
+                 sequence_length: int, tokenizer_id: str):
+        os.makedirs(cache_dir, exist_ok=True)
+        self._source = source
+        self._tokenizer = tokenizer
+        self._width = sequence_length + 1
+        self._sweep_stale_tmps(cache_dir)
+        meta = {
+            "version": CACHE_VERSION,
+            "tokenizer": f"{tokenizer_id}|{_tokenizer_fingerprint(tokenizer)}",
+            "sequence_length": sequence_length,
+            "shards": [
+                {"path": os.path.abspath(f),
+                 "size": os.path.getsize(f),
+                 "mtime_ns": os.stat(f).st_mtime_ns}
+                for f in source.files
+            ],
+        }
+        blob = json.dumps(meta, sort_keys=True).encode()
+        digest = hashlib.sha1(blob).hexdigest()[:16]
+        self.path = os.path.join(cache_dir, f"tokens_{digest}.npy")
+        self._meta_path = os.path.join(cache_dir, f"tokens_{digest}.json")
+        if not self._ready():
+            if self._is_builder():
+                self._build(blob)
+            else:
+                self._wait_for_builder()
+        self.tokens = np.load(self.path, mmap_mode="r")
+        assert self.tokens.shape == (len(source), self._width), (
+            self.tokens.shape, (len(source), self._width))
+
+    def _ready(self) -> bool:
+        return os.path.exists(self.path) and os.path.exists(self._meta_path)
+
+    @staticmethod
+    def _is_builder() -> bool:
+        """Exactly one builder per pod (process 0); single-process -> True."""
+        try:
+            import jax
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    def _wait_for_builder(self) -> None:
+        logger.info(f"Waiting for process 0 to build {self.path} ...")
+        deadline = time.time() + _BUILD_WAIT_TIMEOUT_S
+        while not self._ready():
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"token cache {self.path} was not built within "
+                    f"{_BUILD_WAIT_TIMEOUT_S}s; did process 0 die?")
+            time.sleep(1.0)
+
+    @staticmethod
+    def _sweep_stale_tmps(cache_dir: str) -> None:
+        """Remove day-old ``*.tmp.<pid>`` orphans from killed builders
+        (live builders' tmps are younger and are left alone)."""
+        now = time.time()
+        for name in os.listdir(cache_dir):
+            if ".tmp." not in name:
+                continue
+            p = os.path.join(cache_dir, name)
+            try:
+                if now - os.path.getmtime(p) > _STALE_TMP_AGE_S:
+                    os.unlink(p)
+            except OSError:
+                pass
+
+    def _build(self, meta_blob: bytes) -> None:
+        n = len(self._source)
+        logger.info(f"Pretokenizing {n} documents into {self.path} ...")
+        tmp = self.path + f".tmp.{os.getpid()}"
+        try:
+            arr = np.lib.format.open_memmap(tmp, mode="w+", dtype=np.int32,
+                                            shape=(n, self._width))
+            for i in range(n):
+                arr[i] = np.asarray(self._tokenizer.encode_plus(
+                    self._source.text(i),
+                    max_length=self._width,
+                    padding="max_length",
+                    truncation=True,
+                    padding_side="right",
+                )["input_ids"], dtype=np.int32)
+            arr.flush()
+            del arr
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        meta_tmp = self._meta_path + f".tmp.{os.getpid()}"
+        with open(meta_tmp, "wb") as f:
+            f.write(meta_blob)
+        os.replace(meta_tmp, self._meta_path)
+        logger.info("Pretokenization complete")
+
+
+def maybe_token_cache(pretokenize_dir: str, source, tokenizer,
+                      sequence_length: int,
+                      tokenizer_id: str) -> Optional[TokenCache]:
+    if not pretokenize_dir:
+        return None
+    return TokenCache(pretokenize_dir, source, tokenizer, sequence_length,
+                      tokenizer_id)

@@ -42,6 +42,7 @@ class _ParquetText:
 
     def __init__(self, path: str):
         files = self._resolve(path)
+        self.files = files  # resolved shard list (cache identity, cache.py)
         self._columns = []
         self._offsets: List[int] = []  # start row of each shard
         total = 0
@@ -83,17 +84,26 @@ class ParquetDataset:
     wraparound indexing (ref: dataset.py:24-28)."""
 
     def __init__(self, parquet_file: str, tokenizer, sequence_length: int,
-                 training_samples: int):
+                 training_samples: int, pretokenize_dir: str = "",
+                 tokenizer_id: str = ""):
         self._source = _ParquetText(parquet_file)
         self.tokenizer = tokenizer
         self.sequence_length = sequence_length
         self.training_samples = training_samples
         self._next_index = 0
+        from .cache import maybe_token_cache
+        self._cache = maybe_token_cache(pretokenize_dir, self._source,
+                                        tokenizer, sequence_length,
+                                        tokenizer_id)
 
     def __len__(self) -> int:
         return self.training_samples
 
     def __getitem__(self, idx: int) -> Dict:
+        if self._cache is not None:
+            # memmap row read; identical to the tokenize path bit-for-bit
+            return {"input_ids": self._cache.tokens[
+                idx % self._source.real_length]}
         return self.tokenizer.encode_plus(
             self._source.text(idx),
             max_length=self.sequence_length + 1,

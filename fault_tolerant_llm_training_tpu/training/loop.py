@@ -144,7 +144,9 @@ class Trainer:
         if cfg.data_loading == "map":
             dataset = ParquetDataset(cfg.dataset, self.tokenizer,
                                      cfg.sequence_length,
-                                     cfg.batch_size * cfg.training_steps)
+                                     cfg.batch_size * cfg.training_steps,
+                                     pretokenize_dir=cfg.pretokenize_dir,
+                                     tokenizer_id=cfg.tokenizer_name_or_path)
             collator = CollatorForCLM(cfg.sequence_length,
                                       self.tokenizer.pad_token_id)
             self.loader = DataLoader(dataset, cfg.batch_size, collator)
@@ -260,7 +262,9 @@ class Trainer:
                     f"--eval-frequency is set")
             eval_ds = ParquetDataset(
                 cfg.eval_dataset or cfg.dataset, self.tokenizer,
-                cfg.sequence_length, cfg.batch_size * cfg.eval_batches)
+                cfg.sequence_length, cfg.batch_size * cfg.eval_batches,
+                pretokenize_dir=cfg.pretokenize_dir,
+                tokenizer_id=cfg.tokenizer_name_or_path)
             self.eval_loader = DataLoader(
                 eval_ds, cfg.batch_size,
                 CollatorForCLM(cfg.sequence_length,

@@ -64,6 +64,7 @@ class TrainConfig:
     remat: bool = False  # jax.checkpoint each block (trade FLOPs for HBM)
     master_weights: str = "same"  # same | fp32 (fp32 optimizer master copy)
     data_loading: str = "map"  # map (ParquetDataset path) | packed (iterable)
+    pretokenize_dir: str = ""  # cache dir for one-time tokenization (map path)
     legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
     checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
     eval_dataset: str = ""  # held-out parquet; empty = use --dataset
@@ -181,6 +182,10 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         choices=["same", "fp32"])
     parser.add_argument("--data-loading", type=str, default="map",
                         choices=["map", "packed"])
+    parser.add_argument("--pretokenize-dir", type=str, default="",
+                        help="Tokenize the corpus once into a memmap cache "
+                             "here; steady-state loading becomes a row "
+                             "read (map path only)")
     parser.add_argument("--no-legacy-packing", dest="legacy_packing",
                         action="store_false",
                         help="Fix the reference packing quirks (buffer discard / doc re-read)")

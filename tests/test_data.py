@@ -174,3 +174,51 @@ def test_smoke_harness_runs(tiny_parquet, capsys):
     out = capsys.readouterr().out
     assert "data smoke test OK" in out
     assert "[map] batch" in out and "[packed/fixed]" in out
+
+
+def test_pretokenize_cache_matches_direct_path(tiny_parquet, tok, tmp_path):
+    """Cached rows equal on-the-fly tokenization bit-for-bit, the cache is
+    reused on reconstruction, and a changed config gets its own file."""
+    cache = str(tmp_path / "tokcache")
+    plain = ParquetDataset(tiny_parquet, tok, 16, training_samples=40)
+    cached = ParquetDataset(tiny_parquet, tok, 16, training_samples=40,
+                            pretokenize_dir=cache, tokenizer_id="byte")
+    for i in range(40):
+        np.testing.assert_array_equal(
+            np.asarray(cached[i]["input_ids"], np.int32),
+            np.asarray(plain[i]["input_ids"], np.int32))
+    import os
+
+    files = sorted(os.listdir(cache))
+    npys = [f for f in files if f.endswith(".npy")]
+    assert len(npys) == 1
+    mtime = os.path.getmtime(os.path.join(cache, npys[0]))
+    # reconstruction reuses the existing cache (no rebuild)
+    again = ParquetDataset(tiny_parquet, tok, 16, training_samples=40,
+                           pretokenize_dir=cache, tokenizer_id="byte")
+    assert os.path.getmtime(os.path.join(cache, npys[0])) == mtime
+    np.testing.assert_array_equal(
+        np.asarray(again[7]["input_ids"], np.int32),
+        np.asarray(plain[7]["input_ids"], np.int32))
+    # a different sequence length is a different cache identity
+    ParquetDataset(tiny_parquet, tok, 24, training_samples=40,
+                   pretokenize_dir=cache, tokenizer_id="byte")
+    npys2 = [f for f in os.listdir(cache) if f.endswith(".npy")]
+    assert len(npys2) == 2
+
+
+def test_pretokenize_cache_cli_losses_identical(tmp_path, tiny_parquet):
+    """Full CLI: a --pretokenize-dir run reproduces the uncached loss
+    sequence exactly (same data, same order)."""
+    from test_fault_tolerance import _args, _losses, _run
+
+    base_args = {"--dataset": str(tiny_parquet), "--training-steps": "10"}
+    rc, plain = _run(_args(tmp_path / "a", str(tiny_parquet), **base_args),
+                     job_id="ptk1")
+    assert rc == 0, plain
+    rc, cached = _run(_args(tmp_path / "b", str(tiny_parquet), **dict(
+        base_args, **{"--pretokenize-dir": str(tmp_path / "cache")})),
+        job_id="ptk2")
+    assert rc == 0, cached
+    assert "Pretokenization complete" in cached
+    assert _losses(plain) == _losses(cached)
