@@ -10,15 +10,19 @@ memmap row read — no tokenizer on the hot path, and identical batches to
 the uncached path bit-for-bit (tests/test_data.py).
 
 Cache identity: a digest of the resolved shard list (path, size,
-nanosecond mtime), the sequence length, and a fingerprint of the *loaded*
-tokenizer instance (class + vocab/special ids — NOT the requested name:
-``load_tokenizer`` silently falls back to the byte tokenizer offline, and
-a name-keyed cache would then be poisoned for a later online run) — any
-change produces a new cache file, so stale caches are never read. Writes
-are atomic (build to ``.tmp``, then ``os.replace``) and crash-safe (the
-tmp is unlinked on failure; abandoned tmps from killed builders are swept
-after a day). On multi-host pods only process 0 builds; the others poll
-for the finished cache instead of tokenizing the corpus N times.
+nanosecond mtime), the sequence length, and a *behavioral* fingerprint of
+the loaded tokenizer instance — its class, vocab/special ids, and the ids
+it produces for a fixed probe text (so a retrained tokenizer with the
+same class and vocab size still changes the key). The requested tokenizer
+name is deliberately NOT part of the key: ``load_tokenizer`` silently
+falls back to the byte tokenizer offline, and a name-keyed cache would be
+poisoned for a later online run — while two aliases of the same tokenizer
+share one cache. Any change produces a new cache file, so stale caches
+are never read. Writes are atomic (build to ``.tmp``, then
+``os.replace``) and crash-safe (the tmp is unlinked on failure, touched
+during long builds, and day-old untouched orphans are swept). On
+multi-host pods only process 0 builds; the others poll for the finished
+cache instead of tokenizing the corpus N times.
 """
 
 import hashlib
@@ -37,18 +41,26 @@ _BUILD_WAIT_TIMEOUT_S = 3600
 logger = logging.getLogger()
 
 
+_PROBE_TEXT = "The 3 qUick brown foxes? é中文 #2024"
+
+
 def _tokenizer_fingerprint(tokenizer) -> str:
+    """Class + ids + the token ids of a fixed probe text: a retrained
+    tokenizer with identical class/vocab-size still changes the key."""
+    probe = tokenizer.encode_plus(_PROBE_TEXT, padding=False,
+                                  truncation=False)["input_ids"]
     return (f"{type(tokenizer).__name__}"
             f":v{getattr(tokenizer, 'vocab_size', '?')}"
             f":p{getattr(tokenizer, 'pad_token_id', '?')}"
-            f":b{getattr(tokenizer, 'bos_token_id', '?')}")
+            f":b{getattr(tokenizer, 'bos_token_id', '?')}"
+            f":{','.join(str(int(t)) for t in probe)}")
 
 
 class TokenCache:
     """``tokens[idx]`` -> the padded/truncated input_ids row for ``idx``."""
 
     def __init__(self, cache_dir: str, source, tokenizer,
-                 sequence_length: int, tokenizer_id: str):
+                 sequence_length: int):
         os.makedirs(cache_dir, exist_ok=True)
         self._source = source
         self._tokenizer = tokenizer
@@ -56,7 +68,7 @@ class TokenCache:
         self._sweep_stale_tmps(cache_dir)
         meta = {
             "version": CACHE_VERSION,
-            "tokenizer": f"{tokenizer_id}|{_tokenizer_fingerprint(tokenizer)}",
+            "tokenizer": _tokenizer_fingerprint(tokenizer),
             "sequence_length": sequence_length,
             "shards": [
                 {"path": os.path.abspath(f),
@@ -130,6 +142,10 @@ class TokenCache:
                     truncation=True,
                     padding_side="right",
                 )["input_ids"], dtype=np.int32)
+                if i % 10000 == 0:
+                    # mmap writes don't bump mtime; keep the stale-tmp
+                    # sweeper's hands off multi-day builds
+                    os.utime(tmp)
             arr.flush()
             del arr
             os.replace(tmp, self.path)
@@ -147,9 +163,7 @@ class TokenCache:
 
 
 def maybe_token_cache(pretokenize_dir: str, source, tokenizer,
-                      sequence_length: int,
-                      tokenizer_id: str) -> Optional[TokenCache]:
+                      sequence_length: int) -> Optional[TokenCache]:
     if not pretokenize_dir:
         return None
-    return TokenCache(pretokenize_dir, source, tokenizer, sequence_length,
-                      tokenizer_id)
+    return TokenCache(pretokenize_dir, source, tokenizer, sequence_length)

@@ -84,8 +84,7 @@ class ParquetDataset:
     wraparound indexing (ref: dataset.py:24-28)."""
 
     def __init__(self, parquet_file: str, tokenizer, sequence_length: int,
-                 training_samples: int, pretokenize_dir: str = "",
-                 tokenizer_id: str = ""):
+                 training_samples: int, pretokenize_dir: str = ""):
         self._source = _ParquetText(parquet_file)
         self.tokenizer = tokenizer
         self.sequence_length = sequence_length
@@ -93,8 +92,7 @@ class ParquetDataset:
         self._next_index = 0
         from .cache import maybe_token_cache
         self._cache = maybe_token_cache(pretokenize_dir, self._source,
-                                        tokenizer, sequence_length,
-                                        tokenizer_id)
+                                        tokenizer, sequence_length)
 
     def __len__(self) -> int:
         return self.training_samples

@@ -115,6 +115,23 @@ class Trainer:
                 raise ValueError(
                     f"--batch-size {cfg.batch_size} not divisible by "
                     f"microbatches {micro}")
+        if cfg.grad_accum > 1:
+            if cfg.batch_size % cfg.grad_accum:
+                raise ValueError(
+                    f"--batch-size {cfg.batch_size} not divisible by "
+                    f"--grad-accum {cfg.grad_accum}")
+            slice_batch = cfg.batch_size // cfg.grad_accum
+            data_ways_ = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+            if slice_batch % data_ways_:
+                raise ValueError(
+                    f"per-slice batch {slice_batch} (= --batch-size / "
+                    f"--grad-accum) is not divisible by the data-sharding "
+                    f"extent dp*fsdp = {data_ways_}")
+            if cfg.pp > 1 and slice_batch % (cfg.microbatches or cfg.pp):
+                raise ValueError(
+                    f"per-slice batch {slice_batch} is not divisible by "
+                    f"the pipeline microbatch count "
+                    f"{cfg.microbatches or cfg.pp}")
         data_ways = (self.mesh.shape["data"] * self.mesh.shape["fsdp"])
         if cfg.batch_size % data_ways:
             raise ValueError(
@@ -145,8 +162,7 @@ class Trainer:
             dataset = ParquetDataset(cfg.dataset, self.tokenizer,
                                      cfg.sequence_length,
                                      cfg.batch_size * cfg.training_steps,
-                                     pretokenize_dir=cfg.pretokenize_dir,
-                                     tokenizer_id=cfg.tokenizer_name_or_path)
+                                     pretokenize_dir=cfg.pretokenize_dir)
             collator = CollatorForCLM(cfg.sequence_length,
                                       self.tokenizer.pad_token_id)
             self.loader = DataLoader(dataset, cfg.batch_size, collator)
@@ -234,7 +250,8 @@ class Trainer:
         self.batch_sharding = NamedSharding(self.mesh, batch_pspec())
         self._jit_step = jax.jit(
             make_train_step(self.model, self.optimizer, cfg.grad_max_norm,
-                            microbatches=cfg.microbatches),
+                            microbatches=cfg.microbatches,
+                            grad_accum=cfg.grad_accum),
             donate_argnums=(0,),
             out_shardings=(self.state_shardings, None))
         # AOT-compile now, inside the signal-deferred setup window: a
@@ -263,8 +280,7 @@ class Trainer:
             eval_ds = ParquetDataset(
                 cfg.eval_dataset or cfg.dataset, self.tokenizer,
                 cfg.sequence_length, cfg.batch_size * cfg.eval_batches,
-                pretokenize_dir=cfg.pretokenize_dir,
-                tokenizer_id=cfg.tokenizer_name_or_path)
+                pretokenize_dir=cfg.pretokenize_dir)
             self.eval_loader = DataLoader(
                 eval_ds, cfg.batch_size,
                 CollatorForCLM(cfg.sequence_length,

@@ -115,7 +115,8 @@ def make_eval_step(model, microbatches: int = 0):
 
 
 def make_train_step(model, optimizer: optax.GradientTransformation,
-                    grad_max_norm: float, microbatches: int = 0):
+                    grad_max_norm: float, microbatches: int = 0,
+                    grad_accum: int = 1):
     """Build the pure ``(state, inputs, labels) -> (state, metrics)`` step.
 
     metrics: loss (fp32), grad_norm (fp32; host checks finiteness — the
@@ -124,13 +125,46 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     single leaf the host loop fetches per step (one D2H transfer).
     ``microbatches`` only matters under pipeline parallelism (0 = one
     microbatch per stage).
+
+    ``grad_accum > 1`` splits the batch into that many slices and runs
+    them through one ``lax.scan`` (peak activation memory drops by the
+    factor), accumulating token-weighted gradients in fp32 — exactly the
+    big-batch semantics of the reference's sum-CE / valid-token loss
+    (train.py:101-102): slices with more valid tokens weigh more.
     """
 
     def loss_fn(params, inputs, labels):
         return model_loss(model, params, inputs, labels, microbatches)
 
+    def accum_value_and_grad(params, inputs, labels):
+        if grad_accum <= 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                params, inputs, labels)
+        b = inputs.shape[0] // grad_accum
+        sl_inputs = inputs.reshape(grad_accum, b, *inputs.shape[1:])
+        sl_labels = labels.reshape(grad_accum, b, *labels.shape[1:])
+
+        def body(carry, sl):
+            g_acc, nll_acc, n_acc = carry
+            (loss, n), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, sl[0], sl[1])
+            nf = n.astype(jnp.float32)
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32) * nf, g_acc, grads)
+            return (g_acc, nll_acc + loss * nf, n_acc + n), None
+
+        init = (jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+        (g_acc, nll, n_tot), _ = jax.lax.scan(body, init,
+                                              (sl_inputs, sl_labels))
+        denom = jnp.maximum(n_tot.astype(jnp.float32), 1.0)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / denom).astype(p.dtype), g_acc, params)
+        return (nll / denom, n_tot), grads
+
     def train_step(state: TrainState, inputs: jax.Array, labels: jax.Array):
-        (loss, num_tokens), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, num_tokens), grads = accum_value_and_grad(
             state.params, inputs, labels)
         grads, grad_norm = clip_grads_with_norm(grads, grad_max_norm)
         updates, new_opt_state = optimizer.update(grads, state.opt_state,

@@ -72,6 +72,7 @@ class TrainConfig:
     eval_batches: int = 8  # batches per evaluation pass
     prefetch: int = 2  # host->device prefetch depth (reference has none)
     inflight: int = 2  # max dispatched-but-unfinished steps (bounds signal latency)
+    grad_accum: int = 1  # gradient-accumulation slices per step (memory/batch)
     # Multihost: steps between cluster-wide signal agreements. The agreement
     # is a blocking device allgather that drains the dispatch pipeline, so
     # running it every step would force inflight=1 on a pod; every N steps
@@ -198,6 +199,10 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Evaluate every N steps (0 = off)")
     parser.add_argument("--eval-batches", type=int, default=8,
                         help="Batches per evaluation pass")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="Accumulate gradients over N batch slices per "
+                             "step (token-weighted; peak activation memory "
+                             "drops ~N-fold)")
     parser.add_argument("--prefetch", type=int, default=2)
     parser.add_argument("--inflight", type=int, default=2)
     parser.add_argument("--signal-sync-frequency", type=int, default=5)

@@ -182,7 +182,7 @@ def test_pretokenize_cache_matches_direct_path(tiny_parquet, tok, tmp_path):
     cache = str(tmp_path / "tokcache")
     plain = ParquetDataset(tiny_parquet, tok, 16, training_samples=40)
     cached = ParquetDataset(tiny_parquet, tok, 16, training_samples=40,
-                            pretokenize_dir=cache, tokenizer_id="byte")
+                            pretokenize_dir=cache)
     for i in range(40):
         np.testing.assert_array_equal(
             np.asarray(cached[i]["input_ids"], np.int32),
@@ -195,14 +195,14 @@ def test_pretokenize_cache_matches_direct_path(tiny_parquet, tok, tmp_path):
     mtime = os.path.getmtime(os.path.join(cache, npys[0]))
     # reconstruction reuses the existing cache (no rebuild)
     again = ParquetDataset(tiny_parquet, tok, 16, training_samples=40,
-                           pretokenize_dir=cache, tokenizer_id="byte")
+                           pretokenize_dir=cache)
     assert os.path.getmtime(os.path.join(cache, npys[0])) == mtime
     np.testing.assert_array_equal(
         np.asarray(again[7]["input_ids"], np.int32),
         np.asarray(plain[7]["input_ids"], np.int32))
     # a different sequence length is a different cache identity
     ParquetDataset(tiny_parquet, tok, 24, training_samples=40,
-                   pretokenize_dir=cache, tokenizer_id="byte")
+                   pretokenize_dir=cache)
     npys2 = [f for f in os.listdir(cache) if f.endswith(".npy")]
     assert len(npys2) == 2
 
