@@ -102,21 +102,26 @@ def _from_torch_orientation(sd: Dict[str, np.ndarray], n_layers) -> dict:
 
 def state_to_torch_ckpt(state, n_layers: int, learning_rate: float,
                         warmup_steps: int = 10,
-                        weight_decay: float = 0.01) -> dict:
+                        weight_decay: float = 0.01,
+                        lr_schedule: str = "constant",
+                        decay_steps: int = 0) -> dict:
     """TrainState -> the reference's checkpoint dict (numpy leaves).
 
     ``optimizer``/``lr_scheduler`` entries follow torch AdamW's and
     LambdaLR's ``state_dict()`` schema (ref loads them at train.py:70-77).
-    The exported ``lr``/``_last_lr`` carry the *warmup-scaled* current rate
-    — what a native torch checkpoint would hold mid-warmup — computed from
-    the same schedule the trainer uses (utils/schedules.py)."""
-    from ..utils.schedules import linear_warmup_constant
+    The exported ``lr``/``_last_lr`` carry the *schedule-scaled* current
+    rate — what a native torch checkpoint would hold mid-warmup or
+    mid-decay — via the same schedule resolution the trainer uses
+    (utils/schedules.py build_schedule)."""
+    from ..utils.schedules import build_schedule
 
     from ..models.llama import unstack_layer_params
 
     step = int(np.asarray(state.step))
-    current_lr = float(linear_warmup_constant(learning_rate,
-                                              warmup_steps)(step))
+    # same schedule resolution as the trainer (build_schedule), so a
+    # cosine run exports its true mid-decay rate
+    current_lr = float(build_schedule(learning_rate, warmup_steps,
+                                      lr_schedule, decay_steps)(step))
     # scan-form states (layer_impl="scan": layers/block/... with a leading
     # n_layers axis) export through the loop layout the reference uses
     maybe_unstack = (lambda t: unstack_layer_params(t, n_layers)

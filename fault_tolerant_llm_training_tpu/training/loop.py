@@ -202,7 +202,10 @@ class Trainer:
                     f"moe_experts {self.model_config.moe_experts} not "
                     f"divisible by --ep {cfg.ep}")
         self.model = Transformer(self.model_config)
-        self.optimizer = make_optimizer(cfg.learning_rate, cfg.lr_warmup_steps)
+        self.optimizer = make_optimizer(
+            cfg.learning_rate, cfg.lr_warmup_steps,
+            lr_schedule=cfg.lr_schedule,
+            decay_steps=cfg.lr_decay_steps or cfg.training_steps)
 
         dummy = jnp.zeros((1, cfg.sequence_length), jnp.int32)
 

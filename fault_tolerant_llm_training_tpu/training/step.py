@@ -42,14 +42,18 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array
     return loss, num_valid
 
 
-def make_optimizer(learning_rate: float, warmup_steps: int
+def make_optimizer(learning_rate: float, warmup_steps: int,
+                   lr_schedule: str = "constant", decay_steps: int = 0
                    ) -> optax.GradientTransformation:
     """AdamW with torch defaults (ref: train.py:68 uses torch.optim.AdamW
     defaults: betas (0.9, 0.999), eps 1e-8, weight_decay 0.01) under the
-    reference's linear-warmup-constant schedule (ref: utils.py:32-56).
-    Gradient clipping is applied *before* this transform with the torch
-    coefficient semantics (see utils/grad_clip.py)."""
-    schedule = linear_warmup_constant(learning_rate, warmup_steps)
+    reference's linear-warmup-constant schedule (ref: utils.py:32-56), or
+    warmup-cosine (``lr_schedule="cosine"``, decaying over ``decay_steps``
+    — a beyond-parity option). Gradient clipping is applied *before* this
+    transform with the torch coefficient semantics (utils/grad_clip.py)."""
+    from ..utils.schedules import build_schedule
+    schedule = build_schedule(learning_rate, warmup_steps, lr_schedule,
+                              decay_steps)
     return optax.adamw(learning_rate=schedule, b1=0.9, b2=0.999, eps=1e-8,
                        weight_decay=0.01)
 

@@ -73,6 +73,8 @@ class TrainConfig:
     prefetch: int = 2  # host->device prefetch depth (reference has none)
     inflight: int = 2  # max dispatched-but-unfinished steps (bounds signal latency)
     grad_accum: int = 1  # gradient-accumulation slices per step (memory/batch)
+    lr_schedule: str = "constant"  # constant (reference) | cosine
+    lr_decay_steps: int = 0  # cosine horizon (0 = --training-steps)
     # Multihost: steps between cluster-wide signal agreements. The agreement
     # is a blocking device allgather that drains the dispatch pipeline, so
     # running it every step would force inflight=1 on a pod; every N steps
@@ -199,6 +201,13 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Evaluate every N steps (0 = off)")
     parser.add_argument("--eval-batches", type=int, default=8,
                         help="Batches per evaluation pass")
+    parser.add_argument("--lr-schedule", type=str, default="constant",
+                        choices=["constant", "cosine"],
+                        help="constant = the reference's warmup-constant "
+                             "LambdaLR; cosine decays to 10 percent over "
+                             "--lr-decay-steps")
+    parser.add_argument("--lr-decay-steps", type=int, default=0,
+                        help="cosine decay horizon (0 = --training-steps)")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="Accumulate gradients over N batch slices per "
                              "step (token-weighted; peak activation memory "

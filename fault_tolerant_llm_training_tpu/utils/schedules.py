@@ -22,3 +22,34 @@ def linear_warmup_constant(learning_rate: float, warmup_steps: int):
         return learning_rate * factor
 
     return schedule
+
+
+def linear_warmup_cosine(learning_rate: float, warmup_steps: int,
+                         decay_steps: int, final_fraction: float = 0.1):
+    """Linear warmup (same +1 LambdaLR indexing as the reference) then
+    cosine decay to ``final_fraction * learning_rate`` at ``decay_steps``
+    (beyond-parity: the reference only has warmup-constant)."""
+
+    def schedule(count):
+        warm = jnp.minimum((count + 1.0) / (warmup_steps + 1.0), 1.0)
+        span = jnp.maximum(decay_steps - warmup_steps, 1)
+        progress = jnp.clip((count - warmup_steps) / span, 0.0, 1.0)
+        cos = final_fraction + (1.0 - final_fraction) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * progress))
+        # during warmup progress clips to 0 and cos is exactly 1.0
+        return learning_rate * warm * cos
+
+    return schedule
+
+
+def build_schedule(learning_rate: float, warmup_steps: int,
+                   lr_schedule: str = "constant", decay_steps: int = 0):
+    """Single source of truth for --lr-schedule resolution (the trainer's
+    optimizer and the torch checkpoint exporter must agree on the current
+    rate — checkpoint/convert.py)."""
+    if lr_schedule == "cosine":
+        return linear_warmup_cosine(learning_rate, warmup_steps,
+                                    max(decay_steps, warmup_steps + 1))
+    if lr_schedule == "constant":
+        return linear_warmup_constant(learning_rate, warmup_steps)
+    raise ValueError(f"unknown lr_schedule {lr_schedule!r}")

@@ -50,6 +50,11 @@ def main(argv=None):
                             "reference's per-layer layout")
         s.add_argument("--learning-rate", type=float, default=1e-5)
         s.add_argument("--lr-warmup-steps", type=int, default=10)
+        s.add_argument("--lr-schedule", type=str, default="constant",
+                       choices=["constant", "cosine"],
+                       help="must match the training run so the exported "
+                            "current lr is the schedule's true value")
+        s.add_argument("--lr-decay-steps", type=int, default=0)
         s.add_argument("--checkpoint-path", type=str, required=True,
                        help="Orbax checkpoint root (as in train.py)")
         s.add_argument("--job-id", type=str, required=True,
@@ -146,7 +151,9 @@ def main(argv=None):
         abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
         state, _, step = mngr.restore(abstract, step=args.step)
         out = state_to_torch_ckpt(state, cfg.n_layers, args.learning_rate,
-                                  warmup_steps=args.lr_warmup_steps)
+                                  warmup_steps=args.lr_warmup_steps,
+                                  lr_schedule=args.lr_schedule,
+                                  decay_steps=args.lr_decay_steps)
         out["model"] = {k: _n2t(v) for k, v in out["model"].items()}
         for entry in out["optimizer"]["state"].values():
             entry["step"] = torch.tensor(float(entry["step"]))

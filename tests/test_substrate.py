@@ -92,3 +92,27 @@ def test_hbm_usage_str_formats_and_degrades():
 
     with mock.patch("jax.local_devices", return_value=[_NoStats()]):
         assert metrics.hbm_usage_str() == ""
+
+
+def test_cosine_schedule_shape():
+    """Warmup matches the reference's +1 LambdaLR indexing; then cosine
+    decays to the 10% floor at the horizon and stays there."""
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.utils.schedules import (
+        linear_warmup_constant,
+        linear_warmup_cosine,
+    )
+
+    lr, warm, total = 1e-3, 10, 100
+    cos = linear_warmup_cosine(lr, warm, total)
+    const = linear_warmup_constant(lr, warm)
+    for t in range(warm):  # identical during warmup
+        np.testing.assert_allclose(float(cos(t)), float(const(t)), rtol=1e-6)
+    assert float(cos(warm)) <= lr * 1.0001  # fp32 rounding headroom
+    mid = float(cos((warm + total) // 2))
+    assert 0.1 * lr < mid < lr  # strictly between the endpoints
+    np.testing.assert_allclose(float(cos(total)), 0.1 * lr, rtol=1e-5)
+    np.testing.assert_allclose(float(cos(total + 50)), 0.1 * lr, rtol=1e-5)
+    assert all(float(cos(t)) >= float(cos(t + 1)) - 1e-12
+               for t in range(warm, total))  # monotone decay
