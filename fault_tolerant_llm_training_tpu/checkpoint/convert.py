@@ -126,6 +126,13 @@ def state_to_torch_ckpt(state, n_layers: int, learning_rate: float,
     # n_layers axis) export through the loop layout the reference uses
     maybe_unstack = (lambda t: unstack_layer_params(t, n_layers)
                      if "layers" in t else t)
+    first_block = (state.params.get("layers_0")
+                   or state.params.get("layers", {}).get("block", {}))
+    if "experts" in first_block.get("feed_forward", {}):
+        raise ValueError(
+            "MoE states (moe_experts > 0) have no reference-format "
+            "equivalent — the reference model is dense (ref model.py:218-"
+            "254); only dense checkpoints convert")
     adams = [s for s in jax.tree_util.tree_leaves(
         state.opt_state,
         is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))

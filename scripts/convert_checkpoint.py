@@ -118,6 +118,10 @@ def main(argv=None):
                              enable_async=False)
 
     if args.cmd == "to-tpu":
+        if cfg.moe_experts:
+            p.error("MoE models cannot import reference checkpoints: the "
+                    "reference model is dense, so the torch file has no "
+                    "experts/router params (ref model.py:218-254)")
         ckpt = torch.load(args.input, map_location="cpu",
                           weights_only=False)
         ckpt["model"] = {k: _t2n(v) for k, v in ckpt["model"].items()}
@@ -149,6 +153,16 @@ def main(argv=None):
                               opt_state=optimizer.init(params))
 
         abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        # concrete single-device shardings: checkpoints written by sharded
+        # meshes (fsdp/ep/pp runs) need an explicit placement to restore
+        # outside their original topology. Restore to host CPU — the state
+        # goes straight to numpy, and an fsdp-scale model would not fit
+        # unsharded on one accelerator's HBM
+        one = jax.sharding.SingleDeviceSharding(
+            jax.local_devices(backend="cpu")[0])
+        abstract = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=one),
+            abstract)
         state, _, step = mngr.restore(abstract, step=args.step)
         out = state_to_torch_ckpt(state, cfg.n_layers, args.learning_rate,
                                   warmup_steps=args.lr_warmup_steps,

@@ -246,3 +246,38 @@ def test_cli_end_to_end_torch_round_trip(tmp_path, parquet):
     steps = [str(s) for s in range(11, 20)]
     assert all(s in res_losses for s in steps), resumed
     assert [res_losses[s] for s in steps] == [base_losses[s] for s in steps]
+
+
+def test_moe_state_rejected_with_clear_error():
+    """MoE param trees cannot map to the reference's dense format; the
+    converter must say so instead of dying on a missing key."""
+    cfg = get_config("tiny-moe", dtype=jnp.float32, param_dtype=jnp.float32,
+                     attention_impl="xla")
+    model = Transformer(cfg)
+    opt = make_optimizer(1e-3, warmup_steps=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt.init(params))
+    with pytest.raises(ValueError, match="MoE states"):
+        state_to_torch_ckpt(state, cfg.n_layers, 1e-3)
+
+
+def test_cli_converts_checkpoint_saved_on_sharded_mesh(tmp_path, parquet):
+    """A checkpoint written by a dp=2 x fsdp=4 run (device-sharded arrays)
+    converts to the torch format: the converter restores with explicit
+    single-device shardings (regression: deserialization used to fail with
+    'sharding should be specified' for any multi-device-saved state)."""
+    torch = pytest.importorskip("torch")
+    ckpts = tmp_path / "ckpts"
+    rc, out = _run(_args(tmp_path, parquet, **{
+        "--checkpoint-path": str(ckpts), "--batch-size": "8",
+        "--training-steps": "8", "--checkpoint-frequency": "8",
+        "--dp": "2", "--fsdp": "4"}), job_id="shcv", xla_devices=8)
+    assert rc == 0, out
+    out_file = tmp_path / "checkpoint_shcv.ckpt"
+    _convert("to-torch", tmp_path, **{"--checkpoint-path": ckpts,
+                                      "--job-id": "shcv",
+                                      "--output": out_file})
+    ckpt = torch.load(out_file, map_location="cpu", weights_only=False)
+    assert ckpt["training_step"] == 8
