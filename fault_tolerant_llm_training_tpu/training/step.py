@@ -17,7 +17,6 @@ from ..ops.ring_attention import zigzag_layout_active, zigzag_perm
 from ..parallel.mesh import mesh_axis_size
 from ..training.state import TrainState
 from ..utils.grad_clip import clip_grads_with_norm
-from ..utils.schedules import linear_warmup_constant
 
 IGNORE_INDEX = -100  # ref: dataset.py:50, train.py:94,101
 
