@@ -96,6 +96,37 @@ def test_zigzag_ring_gradients_match(eight_devices):
                                    rtol=5e-4, atol=5e-5)
 
 
+def test_flash_ring_matches_xla_ring_both_layouts(eight_devices):
+    """The Pallas carry-kernel ring (default) and the plain-einsum ring
+    (impl='xla') are independent implementations of the same math — they
+    must agree tightly (both accumulate in fp32)."""
+    mesh = make_mesh(dp=1, sp=4)
+    for zigzag in (False, True):
+        q, k, v = _qkv(b=1, s=128, h=4, kv=2, d=16, seed=7)
+        if zigzag:
+            perm = zigzag_perm(q.shape[1], 4)
+            q, k, v = q[:, perm], k[:, perm], v[:, perm]
+        with use_mesh(mesh):
+            flash = jax.jit(lambda q, k, v: ring_attention(
+                q, k, v, zigzag=zigzag, impl="flash"))(q, k, v)
+            xla = jax.jit(lambda q, k, v: ring_attention(
+                q, k, v, zigzag=zigzag, impl="xla"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(xla),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_flash_ring_bf16(eight_devices):
+    """bf16 inputs (the production dtype) through the carry kernels."""
+    q, k, v = _qkv(b=1, s=128, h=4, kv=2, d=16, seed=11)
+    want = xla_attention(q, k, v, causal=True)
+    mesh = make_mesh(dp=1, sp=4)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    with use_mesh(mesh):
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v))(qb, kb, vb)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want), rtol=5e-2, atol=5e-2)
+
+
 def test_ring_gradients_match(eight_devices):
     q, k, v = _qkv(b=1, s=64, h=2, kv=2, d=8, seed=5)
 
