@@ -21,21 +21,52 @@ from ..utils.grad_clip import clip_grads_with_norm
 IGNORE_INDEX = -100  # ref: dataset.py:50, train.py:94,101
 
 
-def cross_entropy_loss(logits: jax.Array, labels: jax.Array
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       ce_block: int | None = None
                        ) -> Tuple[jax.Array, jax.Array]:
     """Sum-reduced fp32 CE over flattened (B*S, V) logits, divided by the
     number of non-ignored label tokens (ref: train.py:94,101-102).
 
+    ``ce_block``: None = auto (vocab-blocked CE at vocab >= 64k, dense
+    below); 0 = force dense; >0 = force that vocab block size. The blocked
+    path (ops/cross_entropy.py) never materializes a (B, S, V) fp32 tensor
+    — at the reference's 131k vocab the fp32 logits cast is the largest
+    tensor in the step. When the vocab axis is actually SHARDED (tensor /
+    pipe meshes), auto stays dense: the dense form below is gather-free
+    and partitions cleanly, while the blocked slicing would make the
+    partitioner all-gather the logits.
+
     Returns (loss, num_valid_tokens).
     """
-    logits = logits.astype(jnp.float32)
+    from ..ops.cross_entropy import (
+        AUTO_THRESHOLD,
+        DEFAULT_BLOCK,
+        chunked_softmax_xent,
+    )
+    from ..parallel.sharding import shard_size
     valid = labels != IGNORE_INDEX
     safe_labels = jnp.where(valid, labels, 0)
-    # logsumexp-minus-picked-logit form: identical to -log_softmax[label]
-    # but never materializes the (B, S, V) fp32 log-probability tensor —
-    # the V axis is reduced away immediately, which matters at vocab 131k
-    # (HBM bandwidth, SURVEY.md §2.2).
-    nll = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    if ce_block is None:
+        v = logits.shape[-1]
+        ce_block = (DEFAULT_BLOCK if v >= AUTO_THRESHOLD
+                    and shard_size(v, "vocab") == 1 else 0)
+    if ce_block:
+        nll = chunked_softmax_xent(logits, safe_labels, ce_block)
+    else:
+        # logsumexp-minus-picked-logit form: identical to
+        # -log_softmax[label] but the V axis is reduced away immediately
+        # (no (B, S, V) fp32 log-probability tensor; SURVEY.md §2.2).
+        # The picked logit comes from a masked iota reduction, not
+        # take_along_axis: every op here partitions cleanly when the vocab
+        # axis is sharded (tensor / pipe meshes) — a gather over a sharded
+        # vocab would force the partitioner to all-gather the logits.
+        lf = logits.astype(jnp.float32)
+        m = jax.lax.stop_gradient(jnp.max(lf, axis=-1))
+        lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
+        hit = (jax.lax.broadcasted_iota(jnp.int32, lf.shape, lf.ndim - 1)
+               == safe_labels[..., None])
+        picked = jnp.sum(jnp.where(hit, lf, 0.0), axis=-1)
+        nll = lse - picked
     num_valid = jnp.sum(valid)
     loss = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(num_valid, 1)
     return loss, num_valid
@@ -101,18 +132,41 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
     return cross_entropy_loss(logits, labels)
 
 
-def make_eval_step(model, microbatches: int = 0):
+def make_eval_step(model, microbatches: int = 0, grad_accum: int = 1):
     """Forward-only loss for held-out evaluation (no reference counterpart —
     the reference never evaluates; SURVEY.md §5.5 notes loss is its only
     metric). Returns packed (sum_nll, num_valid) as one fp32 array so the
     host aggregates exactly across batches with one D2H transfer each:
     mean = sum(sum_nll) / sum(num_valid), weighting every token equally
-    even when batches carry different pad counts."""
+    even when batches carry different pad counts.
 
-    def eval_step(params, inputs, labels):
+    ``grad_accum > 1`` slices the eval batch through the same ``lax.scan``
+    accumulation as the train step: a run that needs accumulation to fit
+    activation memory must not get an eval pass with a grad_accum-fold
+    larger activation footprint at the first --eval-frequency boundary."""
+
+    def eval_one(params, inputs, labels):
         loss, num_valid = model_loss(model, params, inputs, labels,
                                      microbatches, train=False)
-        return jnp.stack((loss * num_valid, num_valid.astype(jnp.float32)))
+        return loss * num_valid, num_valid
+
+    def eval_step(params, inputs, labels):
+        if grad_accum <= 1:
+            nll, n = eval_one(params, inputs, labels)
+            return jnp.stack((nll, n.astype(jnp.float32)))
+        b = inputs.shape[0] // grad_accum
+        sl_inputs = inputs.reshape(grad_accum, b, *inputs.shape[1:])
+        sl_labels = labels.reshape(grad_accum, b, *labels.shape[1:])
+
+        def body(carry, sl):
+            nll_acc, n_acc = carry
+            nll, n = eval_one(params, sl[0], sl[1])
+            return (nll_acc + nll, n_acc + n), None
+
+        (nll, n), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (sl_inputs, sl_labels))
+        return jnp.stack((nll, n.astype(jnp.float32)))
 
     return eval_step
 

@@ -31,6 +31,59 @@ def test_cross_entropy_matches_manual():
     np.testing.assert_allclose(float(loss), total / 5, rtol=1e-5)
 
 
+def test_chunked_ce_matches_dense():
+    """The vocab-blocked CE (ops/cross_entropy.py) is an exact
+    reassociation of the dense fp32 logsumexp: values and gradients must
+    agree to fp32 tolerance, including a non-divisible vocab tail and
+    bf16 logits (the production dtype)."""
+    rng = np.random.default_rng(7)
+    b, s, v = 2, 8, 1000 + 7  # tail of 7 at block 256
+    logits = rng.standard_normal((b, s, v)).astype(np.float32) * 3.0
+    labels = rng.integers(0, v, (b, s)).astype(np.int32)
+    labels[0, 3] = -100
+    labels[1, 0] = -100
+    logits, labels = jnp.asarray(logits), jnp.asarray(labels)
+
+    def dense(lg):
+        return cross_entropy_loss(lg, labels, ce_block=0)[0]
+
+    def chunked(lg):
+        return cross_entropy_loss(lg, labels, ce_block=256)[0]
+
+    ld, gd = jax.value_and_grad(dense)(logits)
+    lc, gc = jax.value_and_grad(chunked)(logits)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gc), np.asarray(gd),
+                               rtol=1e-5, atol=1e-7)
+
+    # bf16 logits: dlogits come back in bf16 through both paths
+    lb = logits.astype(jnp.bfloat16)
+    ld16, gd16 = jax.value_and_grad(dense)(lb)
+    lc16, gc16 = jax.value_and_grad(chunked)(lb)
+    assert gc16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(float(lc16), float(ld16), rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(gc16, np.float32),
+                               np.asarray(gd16, np.float32),
+                               rtol=5e-2, atol=1e-4)
+
+
+def test_chunked_ce_auto_dispatch_threshold():
+    """ce_block=None auto-selects the blocked path only at large vocab —
+    pinned by checking the jaxpr for the custom VJP primitive name."""
+    from fault_tolerant_llm_training_tpu.ops.cross_entropy import (
+        AUTO_THRESHOLD,
+    )
+    small = jnp.zeros((1, 4, 128), jnp.float32)
+    labels = jnp.zeros((1, 4), jnp.int32)
+    jaxpr_small = str(jax.make_jaxpr(
+        lambda lg: cross_entropy_loss(lg, labels)[0])(small))
+    assert "custom_vjp" not in jaxpr_small
+    big = jnp.zeros((1, 4, AUTO_THRESHOLD), jnp.float32)
+    jaxpr_big = str(jax.make_jaxpr(
+        lambda lg: cross_entropy_loss(lg, labels)[0])(big))
+    assert "custom_vjp" in jaxpr_big
+
+
 def _run_steps(n_steps, seed=0):
     cfg = get_config("tiny", attention_impl="xla", dtype=jnp.float32,
                      param_dtype=jnp.float32)
