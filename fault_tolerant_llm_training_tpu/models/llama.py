@@ -68,11 +68,33 @@ class TokenEmbed(nn.Module):
                          (cfg.vocab_size, cfg.dim), cfg.param_dtype)
         impl = cfg.embed_impl
         if impl == "auto":
-            impl = "one_hot" if mesh_axis_size("tensor") > 1 else "gather"
+            # one_hot only when the VOCAB dim actually shards ('tensor' /
+            # 'pipe' after the divisibility degrade, parallel/sharding.py):
+            # there a gather would force the partitioner into involuntary
+            # full rematerialization, while contracting vocab is a clean
+            # MXU matmul + psum. With the vocab dim replicated (fsdp-only
+            # meshes shard the table's FEATURE dim; dp-only meshes nothing)
+            # gather stays the impl: the one_hot form was measured to
+            # deadlock XLA's in-process CPU collectives on an fsdp-sharded
+            # table under sustained multi-step load (2/3 runs on the
+            # 8-virtual-device mesh), and gather is cheapest anyway.
+            from ..parallel.sharding import shard_size
+            impl = ("one_hot" if shard_size(cfg.vocab_size, "vocab") > 1
+                    else "gather")
         if impl == "one_hot":
             one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+            # Pin the one-hot to the table's vocab sharding: the iota
+            # compare generates each device's slice for free, so no
+            # full-V (B, S, V) tensor exists per device.
+            one_hot = constrain(one_hot, "batch", "seq", "vocab")
             return one_hot @ emb.astype(cfg.dtype)
-        return jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+        # Gather: pin the OUTPUT to the activation layout so the
+        # partitioner plans the table reshard (feature all-gather) up
+        # front instead of discovering the mismatch at the gather's
+        # consumer and rematerializing (the round-1 dryrun warning on
+        # fsdp/ep meshes).
+        out = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+        return constrain(out, "batch", "seq", "act_embed")
 
 
 class Attention(nn.Module):

@@ -15,10 +15,12 @@ portfolio (dp / pp / fsdp / sp / tp). TPU-native design:
 - microbatches flow stage-to-stage via ``lax.ppermute`` in a GPipe
   schedule of ``M + P - 1`` ticks (bubble fraction (P-1)/(M+P-1));
   autodiff through the schedule yields the reverse pipeline for free;
-- embedding and head run *outside* the shard_map, replicated over ``pipe``
-  by the auto partitioner — redundant FLOPs on P-1 stages, traded for a
-  schedule that needs no stage-conditional branches around the (B, S, V)
-  head matmul.
+- embedding and head run *outside* the shard_map under the auto
+  partitioner, with the vocab axis sharded over ``('tensor', 'pipe')``
+  (parallel/sharding.py): every stage stores only its vocab slice of the
+  embed table / head weight and computes only its slice of the (B, S, V)
+  head matmul — one head matmul total across the mesh, reduced by the
+  gather-free CE (training/step.py) with small (B, S) collectives.
 
 The jitted result computes exactly the same function as the plain trunk
 (tests/test_pipeline.py pins loss equivalence on the CPU mesh).
@@ -67,61 +69,62 @@ def pipeline_hidden(model, params, x, positions, mesh=None,
         return out
 
     compute_dtype = x.dtype
+    b, seq, d = x.shape
+    mb = b // n_micro
 
-    def body(stack_local, x, pos):
+    # Split into microbatches OUTSIDE the manual region, pad with the pp-1
+    # drain ticks, and pin the sharding explicitly: the scan below then
+    # consumes its xs natively (no dynamic_index over an axis the reshape
+    # silently left batch-sharded — that indexing forced the partitioner
+    # into an involuntary full rematerialization per tick). The constraint
+    # puts the batch sharding on the per-microbatch batch dim when it
+    # divides, and degrades to explicit (voluntary) replication when it
+    # does not (tiny dryrun shapes).
+    from ..parallel.sharding import constrain, suspend_constraints
+    micro = x.astype(jnp.float32).reshape(n_micro, mb, seq, d)
+    micro = jnp.concatenate(
+        [micro, jnp.zeros((pp - 1, mb, seq, d), jnp.float32)], axis=0)
+    micro = constrain(micro, None, "batch", None, None)
+
+    def body(stack_local, micro, pos):
         s = jax.lax.axis_index("pipe")
         # boundary values travel in fp32: the cotangent of a replicated
         # (P()) shard_map input is accumulated with a psum over 'pipe', and
         # bf16 psums inside a partial-manual shard_map trip an XLA
         # partitioner CHECK (jax 0.9 / XLA CPU) — compute stays bf16
-        x = x.astype(compute_dtype)
-        b, seq, d = x.shape
-        mb = b // n_micro
-        micro = x.reshape(n_micro, mb, seq, d)
+        micro = micro.astype(compute_dtype)
         ring = [(i, (i + 1) % pp) for i in range(pp)]
 
         # One lax.scan over the ticks (not an unrolled Python loop): the
         # layer scan inside is traced once, keeping compile time O(1) in
         # microbatches — the same reason the trunk itself is scanned.
-        def tick(carry, t):
-            buf, recv = carry
-            inject = jnp.where(
-                t < n_micro,
-                jax.lax.dynamic_index_in_dim(
-                    micro, jnp.minimum(t, n_micro - 1), 0, keepdims=False),
-                jnp.zeros((mb, seq, d), x.dtype))
-            xin = jnp.where(s == 0, inject, recv)
+        # Stage 0 injects microbatch t at tick t; stage P-1 emits finished
+        # microbatch t-P+1, so the stacked ys hold them from tick P-1 on.
+        def tick(recv, x_t):
+            xin = jnp.where(s == 0, x_t, recv)
             out = local_layers(stack_local, xin, pos)
             recv = jax.lax.ppermute(out, "pipe", ring)
-            # stage P-1 finished microbatch t-P+1 this tick; earlier ticks
-            # (and other stages, masked below) write a no-op
-            idx = jnp.clip(t - pp + 1, 0, n_micro - 1)
-            cur = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
-            upd = jnp.where(t >= pp - 1, out, cur)
-            buf = jax.lax.dynamic_update_index_in_dim(buf, upd, idx, 0)
-            return (buf, recv), None
+            return recv, out
 
-        buf = jnp.zeros_like(micro)
-        recv = jnp.zeros((mb, seq, d), x.dtype)
-        (buf, _), _ = jax.lax.scan(tick, (buf, recv),
-                                   jnp.arange(n_micro + pp - 1))
-        buf = jnp.where(s == pp - 1, buf, jnp.zeros((), x.dtype))
+        recv = jnp.zeros((mb, seq, d), compute_dtype)
+        _, outs = jax.lax.scan(tick, recv, micro)
+        outs = outs[pp - 1:]  # (n_micro, mb, seq, d), static slice
+        outs = jnp.where(s == pp - 1, outs, jnp.zeros((), compute_dtype))
         # broadcast the last stage's result to every stage; fp32 for the
         # same partitioner reason as above, and it doubles as the fp32
         # boundary on the way out
-        buf = jax.lax.psum(buf.astype(jnp.float32), "pipe")
-        return buf.reshape(b, seq, d)
+        outs = jax.lax.psum(outs.astype(jnp.float32), "pipe")
+        return outs.reshape(b, seq, d)
 
     stack_specs = jax.tree_util.tree_map(
         lambda leaf: P("pipe"), stacked)
     fn = shard_map(body, mesh=mesh,
                    in_specs=(stack_specs, P(), P()),
                    out_specs=P(), axis_names={"pipe"}, check_vma=False)
-    from ..parallel.sharding import suspend_constraints
     with suspend_constraints():
         # constraints inside the manual region would stamp all-auto-mesh
         # shardings that break the shard_map transpose (see sharding.py)
-        hidden = fn(stacked, x.astype(jnp.float32), positions)
+        hidden = fn(stacked, micro, positions)
     return hidden.astype(x.dtype)
 
 

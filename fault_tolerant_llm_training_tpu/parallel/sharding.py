@@ -52,7 +52,14 @@ def suspend_constraints():
 LOGICAL_RULES: Dict[str, object] = {
     "batch": ("data", "fsdp"),
     "seq": "sequence",
-    "vocab": "tensor",
+    # vocab shards over tensor AND pipe: on a pp mesh every stage stores
+    # only its vocab slice of the embed table / head weight and computes
+    # only its slice of the (B, S, V) logits — one head matmul total
+    # across the mesh instead of P replicated ones (the round-1 pipeline
+    # recomputed the model's largest matmul on every stage). The CE is
+    # gather-free (training/step.py) so vocab-sharded logits reduce with
+    # small (B, S) collectives, never an all-gather of logits.
+    "vocab": ("tensor", "pipe"),
     "embed": "fsdp",
     # activations keep their feature dim replicated (FSDP shards params, not
     # activations; 'embed' -> fsdp applies to parameter matrices only)
@@ -93,6 +100,69 @@ def _resolve(logical_axes, rules=None) -> P:
     return P(*(rules.get(a) if a is not None else None for a in logical_axes))
 
 
+_FIT_WARNED = set()
+
+
+def _fit_spec(spec: P, shape, mesh) -> P:
+    """Drop mesh axes a dimension cannot actually be sharded over.
+
+    An indivisible dim (e.g. the byte tokenizer's 259-entry vocab over a
+    ('tensor', 'pipe') product) would be a hard pjit error; degrading that
+    dim to the divisible prefix of its axes (possibly replicated) is always
+    semantically valid — the same per-axis degrade the ring attention op
+    applies to its batch axes. Dropping an axis on a non-trivial dim is
+    logged once per (dim, axes) pair: silent replication of a large param
+    or batch is a real capacity/compute cost the operator should see."""
+    if mesh is None:
+        return spec
+    fitted = []
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            fitted.append(None)
+            continue
+        keep, dropped, prod = [], [], 1
+        for a in axes if isinstance(axes, tuple) else (axes,):
+            n = mesh.shape.get(a, 1)
+            if dim % (prod * n) == 0:
+                keep.append(a)
+                prod *= n
+            elif n > 1:
+                dropped.append(a)
+        if dropped and dim >= 64 and (dim, tuple(dropped)) not in _FIT_WARNED:
+            _FIT_WARNED.add((dim, tuple(dropped)))
+            import logging
+            logging.getLogger(__name__).warning(
+                "sharding: dim %d is not divisible by mesh axes %s "
+                "(sizes %s); that dim degrades to %s — replicated work/"
+                "storage where sharding was requested",
+                dim, dropped, [mesh.shape.get(a, 1) for a in dropped],
+                keep or "replicated")
+        fitted.append(tuple(keep) if len(keep) > 1
+                      else (keep[0] if keep else None))
+    return P(*fitted)
+
+
+def shard_size(dim: int, logical_axis: str, mesh=None) -> int:
+    """How many ways ``dim`` would actually shard over ``logical_axis`` on
+    the active mesh, after the :func:`_fit_spec` divisibility degrade.
+
+    The dispatch predicate for layout-sensitive implementation choices
+    (e.g. embed gather-vs-one_hot, dense-vs-blocked CE): axis size alone
+    lies when the dim is indivisible and silently degrades to replication.
+    """
+    mesh = mesh or active_mesh()
+    if mesh is None:
+        return 1
+    spec = _fit_spec(_resolve((logical_axis,)), (dim,), mesh)
+    axes = spec[0]
+    if axes is None:
+        return 1
+    prod = 1
+    for a in (axes if isinstance(axes, tuple) else (axes,)):
+        prod *= mesh.shape.get(a, 1)
+    return prod
+
+
 def logical_pspec(*logical_axes) -> P:
     return _resolve(logical_axes)
 
@@ -123,7 +193,7 @@ def constrain(x: jax.Array, *logical_axes) -> jax.Array:
             # still break under autodiff replay); the auto axes' shardings
             # propagate from the body's inputs, so skip the hint here.
             return x
-    spec = _resolve(logical_axes)
+    spec = _fit_spec(_resolve(logical_axes), x.shape, mesh)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
@@ -145,7 +215,7 @@ def param_pspecs(params) -> dict:
                     raise ValueError(
                         f"rule {pattern!r} gives {len(axes)} axes for {path} "
                         f"with ndim {leaf.ndim}")
-                return _resolve(axes)
+                return _fit_spec(_resolve(axes), leaf.shape, active_mesh())
         return P(*([None] * leaf.ndim))  # replicate unknown params
 
     flat = jax.tree_util.tree_flatten_with_path(params)

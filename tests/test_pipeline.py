@@ -121,6 +121,36 @@ def test_pipeline_params_shard_by_stage(eight_devices):
     assert shard[0] == cfg.n_layers // 2  # one layer per stage at pp=2
 
 
+def test_pipeline_head_not_replicated(eight_devices):
+    """The vocab axis shards over 'pipe' (parallel/sharding.py), so each
+    stage computes only its slice of the (B, S, V) head matmul — one head
+    matmul total across the mesh, not P replicated copies (the round-1
+    pipeline recomputed the model's largest matmul on every stage). Pinned
+    on the optimized HLO: no dot in the compiled loss produces a full-V
+    array, and the head dot produces V/pp columns per device."""
+    import re
+
+    from fault_tolerant_llm_training_tpu.training.step import model_loss
+
+    cfg, model, params, tokens = _setup(batch=4)
+    v = cfg.vocab_size
+    mesh = make_mesh(dp=1, pp=2)
+    labels = np.concatenate(
+        [tokens[:, 1:], np.full((4, 1), -100, np.int32)], axis=1)
+    with use_mesh(mesh):
+        fn = jax.jit(jax.grad(
+            lambda p, t, l: model_loss(model, p, t, l)[0]))
+        hlo = fn.lower(params, jnp.asarray(tokens),
+                       jnp.asarray(labels)).compile().as_text()
+    dot_shapes = re.findall(
+        r"= f\d+\[([\d,]+)\]\{[\d,]*\} dot\(", hlo)
+    last_dims = [int(s.split(",")[-1]) for s in dot_shapes if s]
+    assert v // 2 in last_dims  # the sharded head matmul exists...
+    assert v not in last_dims   # ...and no dot produces full-V logits
+    # ...and no op of any kind materializes a full-V array per device
+    assert not re.search(r"\[(?:[\d]+,)*%d\]" % v, hlo)
+
+
 def test_pipeline_checkpoint_resumes_on_non_pipelined_mesh(tmp_path,
                                                            parquet):
     """Cross-topology resume across the pipe axis (SURVEY.md §7.3 hard
