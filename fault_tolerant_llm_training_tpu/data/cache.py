@@ -103,13 +103,18 @@ class TokenCache:
             return True
 
     def _wait_for_builder(self) -> None:
-        logger.info(f"Waiting for process 0 to build {self.path} ...")
+        # Polling assumes pretokenize_dir is on a filesystem shared by all
+        # hosts (documented at --pretokenize-dir): with a host-local path
+        # the cache can never appear here, only time out below.
+        logger.info(f"Waiting for process 0 to build {self.path} "
+                    f"(pretokenize dir must be on a shared filesystem) ...")
         deadline = time.time() + _BUILD_WAIT_TIMEOUT_S
         while not self._ready():
             if time.time() > deadline:
                 raise TimeoutError(
                     f"token cache {self.path} was not built within "
-                    f"{_BUILD_WAIT_TIMEOUT_S}s; did process 0 die?")
+                    f"{_BUILD_WAIT_TIMEOUT_S}s; did process 0 die — or is "
+                    f"--pretokenize-dir not on a shared filesystem?")
             time.sleep(1.0)
 
     @staticmethod

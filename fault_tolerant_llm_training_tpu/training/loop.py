@@ -280,6 +280,13 @@ class Trainer:
                 raise ValueError(
                     f"--eval-batches {cfg.eval_batches} must be >= 1 when "
                     f"--eval-frequency is set")
+            if not cfg.eval_dataset:
+                logger.warning(
+                    "--eval-frequency is set without --eval-dataset: "
+                    "'held-out' eval will run on the first %d training "
+                    "samples — exactly the ones the map loader trains on "
+                    "first, so eval loss can look optimistically low",
+                    cfg.batch_size * cfg.eval_batches)
             eval_ds = ParquetDataset(
                 cfg.eval_dataset or cfg.dataset, self.tokenizer,
                 cfg.sequence_length, cfg.batch_size * cfg.eval_batches,
@@ -291,7 +298,8 @@ class Trainer:
             self._eval_batches_cache = None  # tokenized once, first pass
             self._compiled_eval = jax.jit(
                 make_eval_step(self.model,
-                               microbatches=cfg.microbatches)).lower(
+                               microbatches=cfg.microbatches,
+                               grad_accum=cfg.grad_accum)).lower(
                 self.abstract_state.params, batch_struct,
                 batch_struct).compile()
 

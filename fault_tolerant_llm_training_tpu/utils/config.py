@@ -188,7 +188,10 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--pretokenize-dir", type=str, default="",
                         help="Tokenize the corpus once into a memmap cache "
                              "here; steady-state loading becomes a row "
-                             "read (map path only)")
+                             "read (map path only). On multi-host pods this "
+                             "MUST be on a filesystem shared by all hosts: "
+                             "process 0 builds, the others poll for the "
+                             "finished cache file")
     parser.add_argument("--no-legacy-packing", dest="legacy_packing",
                         action="store_false",
                         help="Fix the reference packing quirks (buffer discard / doc re-read)")

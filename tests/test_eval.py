@@ -51,6 +51,18 @@ def test_eval_step_matches_loss_times_tokens():
     assert float(packed[1]) == float(n) == 57  # 2*32 - 2 shifts - 5 masked
 
 
+def test_eval_step_grad_accum_slices_match_full_batch():
+    """grad_accum > 1 runs eval through the same lax.scan slicing as the
+    train step (activation footprint parity — ADVICE round 1); the packed
+    (sum_nll, num_valid) must be identical to the one-shot eval."""
+    model, params, toks, labels = _model_and_batch()
+    full = jax.jit(make_eval_step(model))(params, toks, labels)
+    sliced = jax.jit(make_eval_step(model, grad_accum=2))(
+        params, toks, labels)
+    np.testing.assert_allclose(np.asarray(sliced), np.asarray(full),
+                               rtol=1e-6)
+
+
 def test_eval_step_is_deterministic():
     model, params, toks, labels = _model_and_batch()
     f = jax.jit(make_eval_step(model))
