@@ -196,8 +196,22 @@ def _offs(q_off, k_off):
                       jnp.asarray(k_off, jnp.int32)])
 
 
-def _row(spec_block, index_map):
-    return pl.BlockSpec(spec_block, index_map)
+def _q_major_kv_idx(bq, bk, group, causal):
+    """(b, h, qi, ki)-grid KV index map, shared by carry_fwd and carry_dq.
+
+    With ``causal``, clamps the fetch index of k-blocks wholly in the
+    causal future of the q tile — the pipeline then skips the HBM fetch
+    (compute is skipped by the kernel's ``useful`` predicate either way).
+    One definition so the fwd and dq kernels can never fetch differently.
+    """
+    if causal:
+        def kv_idx(bi, hi, qi, ki, offs):
+            last = (offs[0] + (qi + 1) * bq - 1 - offs[1]) // bk
+            return (bi, hi // group, jnp.minimum(ki, jnp.maximum(last, 0)), 0)
+    else:
+        def kv_idx(bi, hi, qi, ki, offs):
+            return (bi, hi // group, ki, 0)
+    return kv_idx
 
 
 def carry_fwd(q, k, v, m, l, acc, q_off, k_off, *, causal=True,
@@ -219,26 +233,14 @@ def carry_fwd(q, k, v, m, l, acc, q_off, k_off, *, causal=True,
     def q_idx(bi, hi, qi, ki, offs):
         return (bi, hi, qi, 0)
 
-    if causal:
-        def kv_idx(bi, hi, qi, ki, offs):
-            # Fetch-elide blocks wholly in the causal future of this q tile.
-            last = (offs[0] + (qi + 1) * bq - 1 - offs[1]) // bk
-            return (bi, hi // group, jnp.minimum(ki, jnp.maximum(last, 0)), 0)
-    else:
-        def kv_idx(bi, hi, qi, ki, offs):
-            return (bi, hi // group, ki, 0)
-
-    def out_idx(bi, hi, qi, ki, offs):
-        return (bi, hi, qi, 0)
-
-    row = _row((1, 1, bq, 1), out_idx)
-    mat = _row((1, 1, bq, d), out_idx)
+    kv_idx = _q_major_kv_idx(bq, bk, group, causal)
+    row = pl.BlockSpec((1, 1, bq, 1), q_idx)
+    mat = pl.BlockSpec((1, 1, bq, d), q_idx)
+    kv = pl.BlockSpec((1, 1, bk, d), kv_idx)
     gs = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
-        in_specs=[_row((1, 1, bq, d), q_idx),
-                  _row((1, 1, bk, d), kv_idx), _row((1, 1, bk, d), kv_idx),
-                  row, row, mat],
+        in_specs=[mat, kv, kv, row, row, mat],
         out_specs=[row, row, mat],
     )
     kernel = functools.partial(_carry_fwd_kernel, block_q=bq, block_k=bk,
@@ -268,17 +270,10 @@ def carry_dq(q, k, v, do, lse, delta, dq, q_off, k_off, *, causal=True,
     def q_idx(bi, hi, qi, ki, offs):
         return (bi, hi, qi, 0)
 
-    if causal:
-        def kv_idx(bi, hi, qi, ki, offs):
-            last = (offs[0] + (qi + 1) * bq - 1 - offs[1]) // bk
-            return (bi, hi // group, jnp.minimum(ki, jnp.maximum(last, 0)), 0)
-    else:
-        def kv_idx(bi, hi, qi, ki, offs):
-            return (bi, hi // group, ki, 0)
-
-    qmat = _row((1, 1, bq, d), q_idx)
-    qrow = _row((1, 1, bq, 1), q_idx)
-    kmat = _row((1, 1, bk, d), kv_idx)
+    kv_idx = _q_major_kv_idx(bq, bk, group, causal)
+    qmat = pl.BlockSpec((1, 1, bq, d), q_idx)
+    qrow = pl.BlockSpec((1, 1, bq, 1), q_idx)
+    kmat = pl.BlockSpec((1, 1, bk, d), kv_idx)
     gs = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
@@ -323,9 +318,9 @@ def carry_dkv(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *, causal=True,
     def kv_idx(bi, hi, ki, qi, offs):
         return (bi, hi, ki, 0)
 
-    qmat = _row((1, group, bq, d), q_idx)
-    qrow = _row((1, group, bq, 1), q_idx)
-    kmat = _row((1, 1, bk, d), kv_idx)
+    qmat = pl.BlockSpec((1, group, bq, d), q_idx)
+    qrow = pl.BlockSpec((1, group, bq, 1), q_idx)
+    kmat = pl.BlockSpec((1, 1, bk, d), kv_idx)
     gs = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,

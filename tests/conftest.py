@@ -13,10 +13,21 @@ import os
 
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Virtual devices serialize on few cores: a collective legitimately waits
+# while its peers' compute grinds through the same core(s), and XLA's
+# in-process stuck detector would abort the run (seen on the flagship-8B
+# test: minutes of single-core RNG/GEMM between peers). Shared with the
+# subprocess harness in test_fault_tolerance.py.
+COLLECTIVE_TIMEOUT_FLAGS = (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_collective_call_warn_stuck" not in flags:
+    flags += " " + COLLECTIVE_TIMEOUT_FLAGS
+os.environ["XLA_FLAGS"] = flags
 
 import numpy as np
 import pytest

@@ -27,6 +27,7 @@ def _env():
     env.pop("XLA_FLAGS", None)
     env["JAX_COMPILATION_CACHE_DIR"] = CACHE  # reuse compiles across runs
     env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    env["PYTHONFAULTHANDLER"] = "1"  # stack dumps on timeout SIGABRT (_run)
     return env
 
 
@@ -57,8 +58,13 @@ def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None,
     env = _env()
     env["SLURM_JOB_ID"] = job_id
     if xla_devices is not None:
+        # Same raised collective-stuck timeouts as the in-process runs
+        # (see COLLECTIVE_TIMEOUT_FLAGS in conftest.py): the 20 s/40 s
+        # defaults abort a many-virtual-device subprocess mid-run.
+        from conftest import COLLECTIVE_TIMEOUT_FLAGS
         env["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={xla_devices}")
+            f"--xla_force_host_platform_device_count={xla_devices} "
+            + COLLECTIVE_TIMEOUT_FLAGS)
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
     if send_signal is not None:
@@ -76,7 +82,25 @@ def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None,
                 break
         proc.wait(timeout=60)
         return proc.returncode, "".join(out_lines)
-    out, _ = proc.communicate(timeout=timeout)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # Reap the child: a leaked trainer keeps grinding the shared CPU
+        # and poisons every later test in the session (observed: two
+        # leaked 8-virtual-device runs starving a third into its own
+        # timeout). SIGABRT first: PYTHONFAULTHANDLER dumps every thread's
+        # stack into the captured output, so the raised error shows WHERE
+        # it hung. CPU-only subprocess — safe to kill.
+        import signal as _signal
+        proc.send_signal(_signal.SIGABRT)
+        try:
+            out, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        raise AssertionError(
+            f"trainer subprocess timed out after {timeout}s; output + "
+            f"faulthandler stacks:\n{out[-8000:]}")
     return proc.returncode, out
 
 

@@ -1,0 +1,148 @@
+"""Execute the flagship llama3-8b config — the reference's exact trained
+shape (ref: train.py:43-53, ~8.05B params) — on the virtual 8-device fsdp
+mesh: >=3 real optimizer steps with finite loss, then a save/restore round
+trip at full state size (params + AdamW moments, ~48 GB in bf16).
+
+The reference's whole evidence base is this model actually training
+(ref: logs/output_444664.out:9-93); round 1 only shape-checked it. This is
+a SLOW test (tens of minutes on a 1-core CPU host; ~48 GB of disk for the
+checkpoint) and runs only when RUN_SLOW_8B=1. Evidence from a real run is
+recorded in logs/flagship_8b_cpu.out and BASELINE.md.
+
+Config deltas from the trained reference shape, all orthogonal to the
+model: seq_len 64 (CPU FLOPs; the reference trains at 2048) and the loop
+trunk. The loop form is load-bearing here, not a preference: under the
+scan trunk XLA hoists the loop-invariant all-gather of the fsdp-sharded
+(32, 4096, ...) weight stacks out of the while loop, materializing a full
+16 GB weight copy per virtual device (8x = OOM-killed at 130 GB RSS on
+this 125 GB host). With 32 unrolled layers the scheduler places each
+layer's gather at its use site and frees it after. Vocab stays 131072, so
+the vocab-blocked CE path (ops/cross_entropy.py) engages exactly as it
+would at the reference scale.
+"""
+
+import gc
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fault_tolerant_llm_training_tpu.checkpoint.manager import (
+    CheckpointManager,
+)
+from fault_tolerant_llm_training_tpu.models import get_config
+from fault_tolerant_llm_training_tpu.parallel.mesh import make_mesh, use_mesh
+from fault_tolerant_llm_training_tpu.utils.harness import synthetic_batch
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("RUN_SLOW_8B") != "1",
+    reason="flagship 8B execution: ~48 GB disk + tens of minutes; "
+           "set RUN_SLOW_8B=1 to run")
+
+
+def test_flagship_8b_trains_and_round_trips(eight_devices, tmp_path):
+    import time
+    t0 = time.time()
+
+    def log(msg):
+        print(f"[8b +{time.time() - t0:7.1f}s] {msg}", flush=True)
+
+    cfg = get_config("llama3-8b", seq_len=64, layer_impl="loop")
+    mesh = make_mesh(fsdp=8)
+    with use_mesh(mesh):
+        # Init on ONE device, then reshard. A sharded init program ends in
+        # FSDP all-gathers that sit idle while 8 virtual devices serialize
+        # ~8B params of RNG through one core — long enough to trip XLA's
+        # CPU in-process collective stuck detector (AwaitAndLogIfStuck ->
+        # abort). Single-device init has no collectives at all; device_put
+        # then lays the state out on the mesh. (Virtual-mesh workaround
+        # only: on real chips the sharded init is the right path, and the
+        # conftest's raised --xla_cpu_collective_* timeouts cover the
+        # step/save collectives here.)
+        log("building state on one device (init ~8.05B params)...")
+        from fault_tolerant_llm_training_tpu.models import Transformer
+        from fault_tolerant_llm_training_tpu.parallel.sharding import (
+            param_pspecs,
+        )
+        from fault_tolerant_llm_training_tpu.training.state import TrainState
+        from fault_tolerant_llm_training_tpu.training.step import (
+            make_optimizer,
+            make_train_step,
+        )
+        from jax.sharding import NamedSharding
+
+        model = Transformer(cfg)
+        opt = make_optimizer(3e-4, warmup_steps=10)
+
+        def init_fn(key):
+            params = model.init(
+                key, jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt.init(params))
+
+        single = jax.jit(init_fn)(jax.random.PRNGKey(0))
+        log("resharding onto the fsdp mesh...")
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), param_pspecs(abstract),
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        state = jax.device_put(single, shardings)
+        jax.block_until_ready(state.params)
+        del single
+        gc.collect()
+        step_fn = jax.jit(make_train_step(model, opt, 1.0),
+                          donate_argnums=(0,),
+                          out_shardings=(shardings, None))
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(state.params))
+        log(f"param count: {n_params:,}")
+        assert abs(n_params - 8.05e9) / 8.05e9 < 0.01
+
+        toks, labels = synthetic_batch(cfg, 1)
+        losses = []
+        for i in range(3):
+            state, metrics = step_fn(state, toks, labels)
+            losses.append(float(metrics["loss"]))
+            log(f"step {i}: loss {losses[-1]:.4f}")
+        assert all(np.isfinite(x) for x in losses)
+        # Random init at vocab 131072: first loss must sit near ln(V).
+        assert abs(losses[0] - np.log(cfg.vocab_size)) < 1.0
+        assert losses[2] < losses[0]  # it is actually optimizing
+
+        # Fingerprint a few leaves before freeing the live state.
+        leaves = jax.tree_util.tree_leaves(state.params)
+        probe = [np.asarray(leaves[i][(0,) * leaves[i].ndim],
+                            dtype=np.float32) for i in (0, len(leaves) // 2,
+                                                        len(leaves) - 1)]
+        step_now = int(state.step)
+
+        log("saving full state (~48 GB)...")
+        mngr = CheckpointManager(str(tmp_path), "flagship", max_to_keep=1)
+        mngr.save(step_now, state, {"probe": "8b"}, wait=True)
+        log("save committed")
+
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            state)
+        del state, leaves
+        gc.collect()
+
+        log("restoring...")
+        restored, data_state, step = mngr.restore(abstract)
+        mngr.close()
+        assert step == step_now and data_state == {"probe": "8b"}
+        r_leaves = jax.tree_util.tree_leaves(restored.params)
+        for want, idx in zip(probe, (0, len(r_leaves) // 2,
+                                     len(r_leaves) - 1)):
+            got = np.asarray(r_leaves[idx][(0,) * r_leaves[idx].ndim],
+                             dtype=np.float32)
+            np.testing.assert_array_equal(got, want)  # bit-exact restore
+        log("restore verified bit-exact on probed leaves")
+
+        # The restored state steps again — optimizer state round-tripped.
+        restored, metrics = step_fn(restored, toks, labels)
+        final = float(metrics["loss"])
+        log(f"post-restore step: loss {final:.4f}")
+        assert np.isfinite(final) and final < losses[0]
