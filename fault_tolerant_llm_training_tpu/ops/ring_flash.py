@@ -236,11 +236,11 @@ def carry_fwd(q, k, v, m, l, acc, q_off, k_off, *, causal=True,
     kv_idx = _q_major_kv_idx(bq, bk, group, causal)
     row = pl.BlockSpec((1, 1, bq, 1), q_idx)
     mat = pl.BlockSpec((1, 1, bq, d), q_idx)
-    kv = pl.BlockSpec((1, 1, bk, d), kv_idx)
+    kvspec = pl.BlockSpec((1, 1, bk, d), kv_idx)
     gs = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
-        in_specs=[mat, kv, kv, row, row, mat],
+        in_specs=[mat, kvspec, kvspec, row, row, mat],
         out_specs=[row, row, mat],
     )
     kernel = functools.partial(_carry_fwd_kernel, block_q=bq, block_k=bk,

@@ -68,19 +68,44 @@ def _run(argv, job_id, timeout=240, send_signal=None, wait_for=None,
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
     if send_signal is not None:
-        # wait until training is underway (wait_for string seen), then signal
+        # wait until training is underway (wait_for string seen), then
+        # signal. Reading runs on a helper thread so a child that wedges
+        # without printing still hits the deadline (a blocking
+        # `for line in proc.stdout` only checks time when a line arrives).
+        import queue as _queue
+        import threading as _threading
+
+        lines = _queue.Queue()
+
+        def _reader():
+            for line in proc.stdout:
+                lines.put(line)
+            lines.put(None)
+
+        _threading.Thread(target=_reader, daemon=True).start()
         out_lines = []
         deadline = time.time() + timeout
         fired = False
-        for line in proc.stdout:
-            out_lines.append(line)
-            if not fired and wait_for in line:
-                proc.send_signal(send_signal)
-                fired = True
+        while True:
+            try:
+                line = lines.get(timeout=max(0.1, deadline - time.time()))
+            except _queue.Empty:
+                line = ""
+            if line is None:
+                break
+            if line:
+                out_lines.append(line)
+                if not fired and wait_for in line:
+                    proc.send_signal(send_signal)
+                    fired = True
             if time.time() > deadline:
                 proc.kill()
                 break
-        proc.wait(timeout=60)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()  # reap: a leaked trainer starves later tests
+            proc.wait()
         return proc.returncode, "".join(out_lines)
     try:
         out, _ = proc.communicate(timeout=timeout)

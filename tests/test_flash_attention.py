@@ -17,6 +17,14 @@ from fault_tolerant_llm_training_tpu.ops.flash_attention import flash_attention
     # masked q-blocks per k-tile) — shapes smaller than the tuned blocks
     # clamp them away and never hit these paths.
     (2048, 2, 1, 32),
+    # d=64 is the PRODUCTION head dim (gpt2-125m and the tuned tile
+    # tables) — round 1 tested d=32 only (VERDICT weak spot #6).
+    (512, 2, 2, 64),
+    (512, 4, 2, 64),   # GQA at d=64
+    # Non-divisible S: 1536 degrades the tuned 1024-row fwd tile to 768
+    # via _fit_block; 328 = 8 * 41 forces the minimal 8-row tile.
+    (1536, 2, 1, 64),
+    (328, 2, 2, 64),
 ])
 def test_flash_matches_reference(s, h, kv, d):
     rng = np.random.default_rng(0)
@@ -38,8 +46,13 @@ def test_flash_gradients_match(s, h, kv, d):
     _check_gradients(s, h, kv, d)
 
 
+def test_flash_gradients_match_d64():
+    _check_gradients(512, 4, 2, 64)
+
+
 @pytest.mark.parametrize("causal", [True, False])
-@pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32)])
+@pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32),
+                                      (512, 2, 2, 64)])
 def test_streaming_kernels_match(s, h, kv, d, causal, monkeypatch):
     """The long-context streaming kernels (grid-streamed loop operand +
     scratch accumulators; selected above STREAM_THRESHOLD) must agree with
