@@ -56,6 +56,14 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Dispatch implementation: "capacity" = GShard static capacity slots
+    # (drops overflow; its static (E, B, C, D) layout is what XLA turns
+    # into the expert all-to-all under --ep), "sorted" = dropless
+    # sort + ragged-dot grouped GEMMs (single expert group only). "auto"
+    # resolves to capacity everywhere — measured faster on v5e than the
+    # ragged-dot path (models/moe.py) — sorted is an explicit opt-in for
+    # its no-token-dropping semantics.
+    moe_impl: str = "auto"
 
     def __post_init__(self):
         # Unknown values would otherwise silently select a default branch
@@ -64,7 +72,9 @@ class TransformerConfig:
                                ("sp_layout", ("zigzag", "contiguous")),
                                ("attention_impl",
                                 ("auto", "xla", "pallas", "ring")),
-                               ("embed_impl", ("auto", "gather", "one_hot"))):
+                               ("embed_impl", ("auto", "gather", "one_hot")),
+                               ("moe_impl",
+                                ("auto", "capacity", "sorted"))):
             if getattr(self, field) not in allowed:
                 raise ValueError(
                     f"{field}={getattr(self, field)!r} not in {allowed}")

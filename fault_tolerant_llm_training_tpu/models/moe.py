@@ -34,13 +34,48 @@ single-device loss trajectory exactly (tests/test_moe.py).
 """
 
 import math
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.mesh import mesh_axis_size
 from ..parallel.sharding import constrain
 from .configs import TransformerConfig
+
+
+class _StackedKernel(nn.Module):
+    """One (E, in, out) expert-stacked kernel, laid out so the param tree
+    path (``experts/w{1,2,3}/kernel``) and init distribution match the
+    capacity impl's ``nn.vmap(FeedForward)`` params — the two dispatch
+    implementations share checkpoints and sharding rules."""
+
+    shape: Tuple[int, ...]
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self):
+        from .llama import _DENSE_INIT
+
+        return self.param("kernel", _DENSE_INIT, self.shape,
+                          self.param_dtype)
+
+
+class _ExpertKernels(nn.Module):
+    """Param holder producing the stacked SwiGLU kernels under
+    ``experts/``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self):
+        cfg = self.cfg
+        e, d, h = cfg.moe_experts, cfg.dim, cfg.ffn_hidden_dim
+        w1 = _StackedKernel((e, d, h), cfg.param_dtype, name="w1")()
+        w3 = _StackedKernel((e, d, h), cfg.param_dtype, name="w3")()
+        w2 = _StackedKernel((e, h, d), cfg.param_dtype, name="w2")()
+        return w1, w3, w2
 
 
 class MoEFeedForward(nn.Module):
@@ -65,41 +100,58 @@ class MoEFeedForward(nn.Module):
         top_w, top_e = jax.lax.top_k(probs, k)  # (B, S, k)
         top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
 
-        capacity = max(1, math.ceil(cfg.moe_capacity_factor * k * s / E))
-        # dispatch/combine — the two (B, S, E, C) one-hots, by far the
-        # largest tensors here — are built directly in the compute dtype:
-        # every (token, expert) pair is written by at most one slot (top_k
-        # experts are distinct), so no cross-slot add ever rounds. The
-        # position/count bookkeeping stays fp32.
-        dispatch = jnp.zeros((b, s, E, capacity), cfg.dtype)
-        combine = jnp.zeros((b, s, E, capacity), cfg.dtype)
-        count = jnp.zeros((b, E), jnp.float32)  # filled slots per expert
-        for slot in range(k):  # k is tiny and static
-            oh = jax.nn.one_hot(top_e[..., slot], E, dtype=jnp.float32)
-            # position of each token within its expert's capacity if every
-            # earlier token (and earlier slot) in its group kept its place
-            pos_in_e = (jnp.cumsum(oh, axis=1) - oh) + count[:, None, :]
-            pos = jnp.sum(pos_in_e * oh, axis=-1)  # (B, S)
-            keep = (pos < capacity).astype(jnp.float32)
-            pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                    dtype=jnp.float32)
-            pair = ((oh * keep[..., None])[..., :, None]
-                    * pos_oh[..., None, :])
-            dispatch = dispatch + pair.astype(cfg.dtype)
-            combine = combine + (
-                pair * top_w[..., slot][..., None, None]).astype(cfg.dtype)
-            count = count + jnp.sum(oh * keep[..., None], axis=1)
-
         # Switch aux loss: E * sum_e f_e * P_e, computed on slot-0 routing
-        # over every token in the batch
+        # over every token in the batch (shared by both dispatch impls)
         f = jnp.mean(jax.nn.one_hot(top_e[..., 0], E, dtype=jnp.float32),
                      axis=(0, 1))
         p = jnp.mean(probs, axis=(0, 1))
         self.sow("losses", "moe_aux", E * jnp.sum(f * p))
 
-        # (E, B, C, D): expert axis sharded over 'expert', batch sub-dim
-        # over the batch axes — without the batch constraint every
-        # data-parallel device would all-gather and compute every group
+        impl = cfg.moe_impl
+        if impl == "auto":
+            # capacity everywhere: measured on v5e (BASELINE.md), the
+            # dropless ragged-dot path runs the expert GEMMs ~50% below
+            # dense-GEMM efficiency and loses end-to-end despite doing
+            # 2.0x instead of 2.5x FFN FLOPs. "sorted" stays selectable
+            # for its semantics (no token dropping).
+            impl = "capacity"
+        if impl == "sorted":
+            if mesh_axis_size("expert") > 1:
+                raise ValueError(
+                    "moe_impl='sorted' is single-expert-group only; use "
+                    "the capacity impl under --ep")
+            return self._sorted_dispatch(x, top_w, top_e)
+
+        capacity = max(1, math.ceil(cfg.moe_capacity_factor * k * s / E))
+        # Per-slot bookkeeping (fp32): position of each token within its
+        # expert's capacity if every earlier token (and earlier slot) in
+        # its group kept its place; overflow (pos >= capacity) drops.
+        count = jnp.zeros((b, E), jnp.float32)  # filled slots per expert
+        slot_idx = []
+        for slot in range(k):  # k is tiny and static
+            oh = jax.nn.one_hot(top_e[..., slot], E, dtype=jnp.float32)
+            pos_in_e = (jnp.cumsum(oh, axis=1) - oh) + count[:, None, :]
+            pos = jnp.sum(pos_in_e * oh, axis=-1)  # (B, S)
+            keep = pos < capacity
+            slot_idx.append(jnp.where(
+                keep, top_e[..., slot] * capacity + pos.astype(jnp.int32),
+                E * capacity))  # dropped -> one index past the last slot
+            count = count + jnp.sum(
+                oh * keep[..., None].astype(jnp.float32), axis=1)
+
+        # Dispatch one-hot (B, S, E, C) built straight from the flattened
+        # slot index in the compute dtype (no fp32 expert-x-position outer
+        # products — dropped pairs index one past the end and one_hot
+        # zeroes them). The einsum layout stays on ALL meshes: a batched
+        # scatter/gather alternative was measured slower on v5e (TPU
+        # scatters lose to MXU one-hot matmuls, BASELINE.md), and under
+        # --ep this static layout is what the partitioner turns into the
+        # token<->expert all-to-all.
+        dispatch = jnp.zeros((b, s, E, capacity), cfg.dtype)
+        for slot in range(k):
+            pos_oh = jax.nn.one_hot(slot_idx[slot], E * capacity,
+                                    dtype=cfg.dtype)
+            dispatch = dispatch + pos_oh.reshape(b, s, E, capacity)
         expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
         expert_in = constrain(expert_in, "expert_stack", "batch", None,
                               "act_embed")
@@ -112,4 +164,46 @@ class MoEFeedForward(nn.Module):
         expert_out = experts(expert_in)  # (E, B, C, D)
         expert_out = constrain(expert_out, "expert_stack", "batch", None,
                                "act_embed")
+
+        combine = jnp.zeros((b, s, E, capacity), cfg.dtype)
+        for slot in range(k):
+            pos_oh = jax.nn.one_hot(slot_idx[slot], E * capacity,
+                                    dtype=jnp.float32)
+            combine = combine + (
+                pos_oh * top_w[..., slot][..., None]).astype(
+                cfg.dtype).reshape(b, s, E, capacity)
         return jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
+
+    def _sorted_dispatch(self, x, top_w, top_e):
+        """Dropless sort-based dispatch over ``jax.lax.ragged_dot``.
+
+        Tokens sort by assigned expert (stable argsort -> deterministic),
+        the three SwiGLU matmuls run as ragged grouped GEMMs against the
+        (E, in, out) kernel stacks, and a scatter-add combines the k
+        weighted expert outputs back per token. No capacity slots and no
+        token dropping — every (token, slot) pair computes — and none of
+        the (B, S, E, C) dispatch/combine one-hots exist, so the overhead
+        beyond the expert GEMMs themselves is one gather, one sort, and
+        one scatter of (N*k) rows. Single-expert-group form (the 'expert'
+        mesh axis stays with the capacity impl, whose static layout is
+        what XLA turns into the token<->expert all-to-all)."""
+        cfg = self.cfg
+        e_cnt, k = cfg.moe_experts, cfg.moe_top_k
+        b, s, d = x.shape
+        n = b * s
+        w1, w3, w2 = _ExpertKernels(cfg, name="experts")()
+        x_flat = x.reshape(n, d)
+        eids = top_e.reshape(n * k)      # slot-major per token (t*k + j)
+        order = jnp.argsort(eids)        # jnp.argsort is stable
+        tok_sorted = jnp.arange(n * k, dtype=jnp.int32)[order] // k
+        xs = jnp.take(x_flat, tok_sorted, axis=0)
+        group_sizes = jnp.bincount(eids, length=e_cnt).astype(jnp.int32)
+        gate = jax.lax.ragged_dot(xs, w1.astype(cfg.dtype), group_sizes)
+        up = jax.lax.ragged_dot(xs, w3.astype(cfg.dtype), group_sizes)
+        out = jax.lax.ragged_dot(
+            (jax.nn.silu(gate) * up).astype(cfg.dtype),
+            w2.astype(cfg.dtype), group_sizes)
+        w_sorted = top_w.reshape(n * k)[order].astype(jnp.float32)
+        y = jnp.zeros((n, d), jnp.float32).at[tok_sorted].add(
+            out.astype(jnp.float32) * w_sorted[:, None])
+        return y.reshape(b, s, d).astype(x.dtype)

@@ -182,7 +182,8 @@ class Trainer:
         moe_over = {k: v for k, v in dict(
             moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
-            moe_aux_weight=cfg.moe_aux_weight).items() if v is not None}
+            moe_aux_weight=cfg.moe_aux_weight,
+            moe_impl=cfg.moe_impl).items() if v is not None}
         self.model_config = get_config(
             cfg.model, vocab_size=vocab, seq_len=cfg.sequence_length,
             dtype=dtype, param_dtype=param_dtype,

@@ -57,6 +57,7 @@ class TrainConfig:
     moe_top_k: Optional[int] = None
     moe_capacity_factor: Optional[float] = None
     moe_aux_weight: Optional[float] = None
+    moe_impl: Optional[str] = None
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     sp_layout: str = "zigzag"  # zigzag (causal-balanced ring) | contiguous
     embed_impl: str = "auto"  # auto | gather | one_hot (one_hot: TP-friendly)
@@ -163,6 +164,11 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--moe-capacity-factor", type=float, default=None)
     parser.add_argument("--moe-aux-weight", type=float, default=None,
                         help="weight of the router load-balancing loss")
+    parser.add_argument("--moe-impl", type=str, default=None,
+                        choices=["auto", "capacity", "sorted"],
+                        help="MoE dispatch: capacity = GShard slots (drops "
+                             "overflow, expert-parallel capable); sorted = "
+                             "dropless ragged-dot grouped GEMMs")
     parser.add_argument("--attention-impl", type=str, default="auto",
                         choices=["auto", "xla", "pallas", "ring"])
     parser.add_argument("--sp-layout", type=str, default="zigzag",

@@ -19,7 +19,8 @@ from ..training.step import make_optimizer, make_train_step
 
 def synthetic_state_and_step(cfg, mesh=None, learning_rate: float = 3e-4,
                              warmup_steps: int = 10,
-                             grad_max_norm: float = 1.0):
+                             grad_max_norm: float = 1.0,
+                             grad_accum: int = 1):
     """Build (state, jitted step_fn) for ``cfg``.
 
     With ``mesh``, params/optimizer are laid out by the path-rule shardings
@@ -34,7 +35,7 @@ def synthetic_state_and_step(cfg, mesh=None, learning_rate: float = 3e-4,
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=opt.init(params))
 
-    step = make_train_step(model, opt, grad_max_norm)
+    step = make_train_step(model, opt, grad_max_norm, grad_accum=grad_accum)
     if mesh is None:
         state = jax.jit(init_fn)(jax.random.PRNGKey(0))
         return state, jax.jit(step, donate_argnums=(0,))

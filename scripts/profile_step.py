@@ -60,6 +60,9 @@ def main():
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--sequence-length", type=int, default=2048)
     p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--grad-accum", type=int, default=1)
+    p.add_argument("--moe-experts", type=int, default=None)
+    p.add_argument("--moe-top-k", type=int, default=None)
     p.add_argument("--trace-dir", default="/tmp/ftl_trace")
     p.add_argument("--top", type=int, default=15)
     args = p.parse_args()
@@ -73,8 +76,11 @@ def main():
     )
     from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
 
-    cfg = get_config(args.model, seq_len=args.sequence_length)
-    state, step = synthetic_state_and_step(cfg)
+    moe_over = {k: v for k, v in dict(
+        moe_experts=args.moe_experts, moe_top_k=args.moe_top_k).items()
+        if v is not None}  # don't clobber preset values with defaults
+    cfg = get_config(args.model, seq_len=args.sequence_length, **moe_over)
+    state, step = synthetic_state_and_step(cfg, grad_accum=args.grad_accum)
     toks, labels = synthetic_batch(cfg, args.batch_size)
     state, m = step(state, toks, labels)  # compile outside the trace
     hard_sync(m)
