@@ -41,7 +41,8 @@ def main():
 
     cfg = get_config("gpt2-125m", vocab_size=50257, seq_len=seq,
                      attention_impl=os.environ.get("BENCH_ATTN", "auto"),
-                     layer_impl=os.environ.get("BENCH_LAYER_IMPL", "loop"))
+                     layer_impl=os.environ.get("BENCH_LAYER_IMPL", "loop"),
+                     remat=bool(int(os.environ.get("BENCH_REMAT", "0"))))
     mesh = make_mesh()  # all local devices on the data axis
     n_chips = len(mesh.devices.flatten())
 

@@ -225,6 +225,10 @@ class Transformer(nn.Module):
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 in_axes=nn.broadcast,
+                # NOTE: nn.scan(unroll=N) was measured and rejected: 62.6k
+                # tokens/s at unroll 2 or 4 vs 80.6k at 1 on the headline
+                # bench (v5e) — the unrolled bodies' param-stack slices
+                # cost more than the recovered cross-layer fusion.
             )(cfg)
         else:
             block = TransformerBlock
@@ -252,7 +256,10 @@ class Transformer(nn.Module):
         precomputed-table path in Attention, broadcasting over batch."""
         return jnp.arange(seq_len, dtype=jnp.int32)[None, :]
 
-    def __call__(self, tokens, positions=None):
+    def hidden_states(self, tokens, positions=None):
+        """embed -> trunk -> final norm, WITHOUT the output projection —
+        the fused head+CE loss (ops/fused_ce.py) consumes these and blocks
+        the head matmul into the loss so logits never materialize."""
         cfg = self.cfg
         x = self.embed(tokens)
         if cfg.layer_impl == "scan":
@@ -263,7 +270,11 @@ class Transformer(nn.Module):
         else:
             for layer in self.layers:
                 x = layer(x, positions)
-        return self.head(x)
+        return self.norm(x)
+
+    def __call__(self, tokens, positions=None):
+        logits = self.output(self.hidden_states(tokens, positions))
+        return constrain(logits, "batch", "seq", "vocab")
 
 
 def stack_layer_params(params: dict, n_layers: int) -> dict:

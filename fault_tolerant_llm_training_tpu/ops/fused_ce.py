@@ -1,0 +1,138 @@
+"""Fused output-head + cross-entropy: CE without materializing logits.
+
+One step beyond the vocab-blocked CE (ops/cross_entropy.py, which still
+reads a materialized (B, S, V) bf16 logits tensor): here the head matmul
+itself is blocked over the vocab dim inside a custom VJP, so **no logits
+tensor of any dtype ever exists** — at the reference's 131k vocab the
+bf16 logits (plus their dlogits cotangent) are the two largest activation
+tensors in the step (ref loss semantics: train.py:101-102).
+
+- **Forward**: for each vocab block, compute ``hidden @ W[:, j:j+block]``
+  (MXU matmul, fp32 accumulation) and fold it into running rowwise
+  (max, shifted-normalizer, picked-logit) stats — the same online
+  logsumexp as the blocked CE. Residuals: hidden, W, labels, lse.
+- **Backward**: recompute each block's logits from the residuals, form
+  ``dS_j = g * (softmax_j - onehot_j)`` for that block only, and
+  contract immediately into the weight gradient ``dW_j = h^T dS_j`` and
+  the hidden gradient ``dh += dS_j W_j^T``. Peak extra memory is one
+  (B, S, block) fp32 slice.
+
+This is the flash-attention recomputation scheme applied to the
+classifier head (sometimes called a "fused/linear cross-entropy").
+Numerics match head-then-CE to fp32-accumulation tolerance
+(tests/test_train_step.py). Single vocab group: callers dispatch away
+when the vocab axis is sharded (training/step.py), where the partitioned
+dense form's psums are the right tool.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .cross_entropy import DEFAULT_BLOCK
+
+# Auto-dispatch point (training/step.py): the fused form pays ~12% step
+# time over materialize-then-chunked-CE when the logits fit (measured at
+# vocab 131k, bs 4 on v5e: 129.5 vs 115.7 ms/step), so it engages only
+# when the estimated logits + cotangent footprint (B*S*V * ~6 bytes)
+# would not fit — at which point it is the difference between training
+# and OOM (vocab 131k, bs 8 on v5e: 244.7 ms/step fused vs 'exceeded hbm
+# capacity by 443 MB' unfused). Sized for 16 GB parts; raise on bigger
+# HBM.
+AUTO_MIN_BYTES = 8e9
+
+
+def _block_logits(hidden, w, j, block):
+    """fp32 (B, S, block) logits of vocab block ``j`` — the only shape at
+    which logits ever exist."""
+    wj = jax.lax.dynamic_slice_in_dim(w, j * block, block, axis=1)
+    return jnp.dot(hidden, wj, preferred_element_type=jnp.float32)
+
+
+def _fwd_stats(hidden, w, labels, block):
+    from .cross_entropy import _block_update
+
+    b, s, _ = hidden.shape
+    v = w.shape[1]
+    m = jnp.full((b, s), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, s), jnp.float32)
+    picked = jnp.zeros((b, s), jnp.float32)
+
+    def body(j, carry):
+        sl = _block_logits(hidden, w, j, block)
+        return _block_update(sl, labels, j * block, *carry)
+
+    m, l, picked = jax.lax.fori_loop(0, v // block, body, (m, l, picked))
+    if v % block:
+        tail = jnp.dot(hidden, w[:, (v // block) * block:],
+                       preferred_element_type=jnp.float32)
+        m, l, picked = _block_update(tail, labels, (v // block) * block,
+                                     m, l, picked)
+    return m + jnp.log(l), picked
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_head_xent(hidden, w, labels, block: int = DEFAULT_BLOCK):
+    """Per-token -log_softmax(hidden @ w)[label], fp32 (B, S).
+
+    ``hidden``: (B, S, D) post-final-norm activations; ``w``: (D, V) head
+    weight; ``labels`` must already be in-range (callers mask ignore
+    positions around this op)."""
+    lse, picked = _fwd_stats(hidden, w, labels, block)
+    return lse - picked
+
+
+def _fx_fwd(hidden, w, labels, block):
+    lse, picked = _fwd_stats(hidden, w, labels, block)
+    return lse - picked, (hidden, w, labels, lse)
+
+
+def _fx_bwd(block, res, g):
+    hidden, w, labels, lse = res
+    b, s, d = hidden.shape
+    v = w.shape[1]
+    gf = g.astype(jnp.float32)
+
+    def block_ds(j0, vb):
+        sl = jnp.dot(
+            hidden, jax.lax.dynamic_slice_in_dim(w, j0, vb, axis=1),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(sl - lse[..., None])
+        loc = labels - j0
+        hit = (loc >= 0) & (loc < vb)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, sl.shape, 2)
+                  == loc[..., None]) & hit[..., None]
+        # dS in the compute dtype: both contractions below are MXU matmuls
+        return (gf[..., None] * (p - onehot.astype(jnp.float32))
+                ).astype(hidden.dtype)
+
+    def body(j, carry):
+        dh, dw = carry
+        ds = block_ds(j * block, block)
+        wj = jax.lax.dynamic_slice_in_dim(w, j * block, block, axis=1)
+        dh = dh + jnp.einsum("bsv,dv->bsd", ds, wj,
+                             preferred_element_type=jnp.float32)
+        dwj = jnp.einsum("bsd,bsv->dv", hidden, ds,
+                         preferred_element_type=jnp.float32)
+        dw = jax.lax.dynamic_update_slice_in_dim(
+            dw, dwj.astype(w.dtype), j * block, axis=1)
+        return dh, dw
+
+    dh = jnp.zeros((b, s, d), jnp.float32)
+    dw = jnp.zeros_like(w)
+    dh, dw = jax.lax.fori_loop(0, v // block, body, (dh, dw))
+    if v % block:
+        j0 = (v // block) * block
+        ds = block_ds(j0, v - j0)
+        wj = w[:, j0:]
+        dh = dh + jnp.einsum("bsv,dv->bsd", ds, wj,
+                             preferred_element_type=jnp.float32)
+        dw = jax.lax.dynamic_update_slice_in_dim(
+            dw, jnp.einsum("bsd,bsv->dv", hidden, ds,
+                           preferred_element_type=jnp.float32
+                           ).astype(w.dtype), j0, axis=1)
+    return dh.astype(hidden.dtype), dw, None
+
+
+fused_head_xent.defvjp(_fx_fwd, _fx_bwd)

@@ -21,6 +21,16 @@ from ..utils.grad_clip import clip_grads_with_norm
 IGNORE_INDEX = -100  # ref: dataset.py:50, train.py:94,101
 
 
+def masked_mean_nll(nll, labels) -> Tuple[jax.Array, jax.Array]:
+    """Sum per-token nll over non-ignored labels / their count (the
+    reference's loss normalization, train.py:94,101-102) — the single
+    assembly shared by every CE form. Returns (loss, num_valid)."""
+    valid = labels != IGNORE_INDEX
+    num_valid = jnp.sum(valid)
+    loss = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(num_valid, 1)
+    return loss, num_valid
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        ce_block: int | None = None
                        ) -> Tuple[jax.Array, jax.Array]:
@@ -52,14 +62,11 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                     and shard_size(v, "vocab") == 1 else 0)
     if ce_block:
         nll = chunked_softmax_xent(logits, safe_labels, ce_block)
-    else:
-        # logsumexp-minus-picked-logit form: identical to
-        # -log_softmax[label] but the V axis is reduced away immediately
-        # (no (B, S, V) fp32 log-probability tensor; SURVEY.md §2.2).
-        # The picked logit comes from a masked iota reduction, not
-        # take_along_axis: every op here partitions cleanly when the vocab
-        # axis is sharded (tensor / pipe meshes) — a gather over a sharded
-        # vocab would force the partitioner to all-gather the logits.
+    elif shard_size(logits.shape[-1], "vocab") > 1:
+        # Vocab-sharded logits (tensor / pipe meshes): pick the label logit
+        # with a masked iota reduction — every op partitions cleanly, where
+        # a take_along_axis gather over the sharded vocab would force the
+        # partitioner to all-gather the logits.
         lf = logits.astype(jnp.float32)
         m = jax.lax.stop_gradient(jnp.max(lf, axis=-1))
         lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
@@ -67,9 +74,15 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                == safe_labels[..., None])
         picked = jnp.sum(jnp.where(hit, lf, 0.0), axis=-1)
         nll = lse - picked
-    num_valid = jnp.sum(valid)
-    loss = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(num_valid, 1)
-    return loss, num_valid
+    else:
+        # logsumexp-minus-picked-logit form: identical to
+        # -log_softmax[label] but the V axis is reduced away immediately
+        # (no (B, S, V) fp32 log-probability tensor; SURVEY.md §2.2).
+        # Measured ~1% faster than the iota form on the single-chip
+        # headline bench, so the replicated-vocab case keeps it.
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), safe_labels)
+    return masked_mean_nll(nll, labels)
 
 
 def make_optimizer(learning_rate: float, warmup_steps: int,
@@ -121,15 +134,48 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
         perm = jnp.asarray(zigzag_perm(inputs.shape[1], sp))
         inputs, labels = inputs[:, perm], labels[:, perm]
         args = (jnp.broadcast_to(perm[None, :], inputs.shape),)
+    from ..ops.cross_entropy import AUTO_THRESHOLD
+    from ..ops.fused_ce import AUTO_MIN_BYTES, fused_head_xent
+    from ..parallel.sharding import shard_size
+    # Per-DEVICE logits + cotangent footprint: batch and seq shard over
+    # their mesh axes, so the global product overestimates on multi-chip
+    # meshes (OOM is a per-device phenomenon).
+    logits_bytes = (
+        inputs.shape[0] // shard_size(inputs.shape[0], "batch")
+        * (inputs.shape[1] // shard_size(inputs.shape[1], "seq"))
+        * (cfg.vocab_size if cfg is not None else 0) * 6)
+    fused = (cfg is not None and cfg.vocab_size >= AUTO_THRESHOLD
+             and logits_bytes > AUTO_MIN_BYTES
+             and shard_size(cfg.vocab_size, "vocab") == 1)
+
+    # One forward (with the MoE routers' sown aux when training), one loss
+    # assembly — the fused path only changes WHICH function maps the
+    # forward's output to per-token nll, so masking/normalization and the
+    # aux handling cannot diverge between the paths.
+    method = "hidden_states" if fused else None
     if cfg is not None and cfg.moe_experts and train:
-        logits, mutated = model.apply({"params": params}, inputs, *args,
-                                      mutable=["losses"])
+        out, mutated = model.apply({"params": params}, inputs, *args,
+                                   method=method, mutable=["losses"])
         aux = sum(jnp.sum(leaf) for leaf in
                   jax.tree_util.tree_leaves(mutated))
-        loss, num_valid = cross_entropy_loss(logits, labels)
-        return loss + cfg.moe_aux_weight * aux, num_valid
-    logits = model.apply({"params": params}, inputs, *args)
-    return cross_entropy_loss(logits, labels)
+    else:
+        out = model.apply({"params": params}, inputs, *args, method=method)
+        aux = None
+    if fused:
+        # Large unsharded vocab whose logits + cotangent would not fit:
+        # block the head matmul into the loss (ops/fused_ce.py) — logits
+        # never materialize in any dtype. See AUTO_MIN_BYTES for the
+        # measured tradeoff.
+        head_w = params["output"]["kernel"].astype(cfg.dtype)
+        safe = jnp.where(labels == IGNORE_INDEX, 0, labels)
+        nll = fused_head_xent(out, head_w, safe,
+                              min(8192, head_w.shape[1]))
+        loss, num_valid = masked_mean_nll(nll, labels)
+    else:
+        loss, num_valid = cross_entropy_loss(out, labels)
+    if aux is not None:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss, num_valid
 
 
 def make_eval_step(model, microbatches: int = 0, grad_accum: int = 1):
