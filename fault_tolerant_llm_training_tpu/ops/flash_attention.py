@@ -29,12 +29,18 @@ VPU economy (attention at head_dim 64 is VPU-bound on TPU, not MXU-bound):
   Backward accumulators run unscaled and are rescaled once per tile at the
   final write (exact: the accumulation is linear).
 
-lse carries a trailing singleton dim — (B, H, S, 1) — because the Pallas
-TPU lowering requires a block's last two dims to be (8k, 128m)-tileable or
-full; (block_q, 1) satisfies that where rank-3 (1, 1, block_q) does not.
-delta (rowwise dO . O) is computed inside the backward kernels from the
-do/o tiles (see _delta) — an XLA-side delta materializes fp32 casts of the
-full dO and O with layout-change copies at the custom-call boundary.
+lse is carried as (B, H, 1, S) — the q positions on the LANE dim. The
+Pallas TPU lowering requires a block's last two dims to be
+(8k, 128m)-tileable or full, and the TPU (8, 128) tile pads whatever
+lands on the trailing dims: a (B, H, S, 1) residual pads its singleton
+lane 128x (measured 95.25 MB per layer at the bench shape, seen in HBM
+dumps), where (1, S) pads the singleton sublane only 8x. Kernels read the
+(1, block_q) row and transpose it to the (block_q, 1) orientation the
+tile math uses — once per q tile (cached in scratch where the k loop is
+the grid). delta (rowwise dO . O) is computed inside the backward kernels
+from the do/o tiles (see _delta) — an XLA-side delta materializes fp32
+casts of the full dO and O with layout-change copies at the custom-call
+boundary.
 
 Two kernel families, dispatched on sequence length:
 
@@ -127,6 +133,31 @@ def _online_softmax_step(q2, k, v, carry, q_start, k_start, masked):
     return m_new, l_new, acc_new
 
 
+def _lse_layout(s: int) -> bool:
+    """Whether to carry lse packed as (B, H, 1, S) instead of the legacy
+    (B, H, S, 1) whose singleton lane the TPU tile pads 128x.
+
+    Packed only for the STREAMING family (long context), where the
+    padding is the point — e.g. 384 MB of padding at S=64k — and only
+    when every q-tile is 128-lane aligned (odd sequence lengths degrade
+    tiles below 128 rows, making the packed blocks illegal). The resident
+    family keeps the legacy layout: packing it was measured 3% slower on
+    the S=2048 headline bench (the per-tile (1, bq) -> (bq, 1) relayouts
+    in the backward hot loops cost more than the ~1 GB of padding they
+    save), while at bs 16 the padding made no wall-clock difference."""
+    return (s > STREAM_THRESHOLD
+            and all(_fit_block(s, b) % 128 == 0
+                    for b in (FWD_BLOCK_Q, DQ_BLOCK_Q, DKV_BLOCK_Q)))
+
+
+def _read_lse(ref, g, packed):
+    """(block_q, 1) column lse from a kernel ref in either layout; ``g``
+    is the GQA group row (0 for per-head refs)."""
+    if packed:
+        return jnp.transpose(ref[0, g])  # (1, bq) -> (bq, 1)
+    return ref[0, g]
+
+
 def _delta(do, o):
     """Rowwise dO . O — the softmax-normalization term, (bq, 1) fp32.
 
@@ -192,7 +223,8 @@ def _k_block_bounds(q_start, block_q, s_k, block_k, causal):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 scale: float, causal: bool):
     # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D);
-    # lse_ref: (1, 1, block_q, 1)
+    # lse_ref: (1, 1, block_q, 1) — the resident family is always legacy
+    # layout (_lse_layout packs the streaming family only)
     q2 = _prescale_q(q_ref[0, 0], scale)
     block_q, d = q2.shape
     s_k = k_ref.shape[2]
@@ -306,10 +338,10 @@ def _stream_bounds(ki, q_start, block_q, n_k, block_k, causal):
 
 def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                        m_scr, l_scr, acc_scr, *, block_q: int, block_k: int,
-                       scale: float, causal: bool):
+                       scale: float, causal: bool, packed: bool):
     # grid (b, h, qi, ki), ki innermost/sequential. q_ref/o_ref:
     # (1, 1, block_q, D) at qi; k_ref/v_ref: (1, 1, block_k, D) at ki;
-    # lse_ref: (1, 1, block_q, 1). Scratch (fp32, persists across ki):
+    # lse_ref: (1, 1, 1, block_q). Scratch (fp32, persists across ki):
     # m/l (block_q, 1), acc (block_q, D).
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -339,16 +371,19 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _emit():
         l = l_scr[...][:, 0]
         o_ref[0, 0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log2(l)[:, None]
+        lse = m_scr[...][:, 0] + jnp.log2(l)
+        lse_ref[0, 0] = lse[None, :] if packed else lse[:, None]
 
 
 def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
-                      dq_ref, dq_scr, delta_scr, *, block_q: int,
-                      block_k: int, scale: float, causal: bool):
+                      dq_ref, dq_scr, delta_scr, lse_scr, *, block_q: int,
+                      block_k: int, scale: float, causal: bool,
+                      packed: bool):
     # grid (b, h, qi, ki), ki innermost. Same tiling as _fwd_stream_kernel
-    # plus do/o at qi; scratch: dq (block_q, D) fp32 and delta (block_q, 1)
-    # fp32, both persisting across ki (delta depends only on the q tile, so
-    # it is computed once at ki == 0).
+    # plus do/o at qi; lse: (1, 1, 1, block_q). Scratch: dq (block_q, D)
+    # fp32, delta and column-oriented lse (block_q, 1) fp32, all persisting
+    # across ki (delta/lse depend only on the q tile, so they are computed
+    # once at ki == 0).
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * block_q
@@ -358,6 +393,7 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
         delta_scr[...] = _delta(do_ref[0, 0], o_ref[0, 0])
+        lse_scr[...] = _read_lse(lse_ref, 0, packed)
 
     useful, masked, n_total = _stream_bounds(ki, q_start, block_q, n_k,
                                              block_k, causal)
@@ -366,7 +402,7 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     def _step():
         q2 = _prescale_q(q_ref[0, 0], scale)
         dq_scr[...] = dq_scr[...] + _dq_tile(
-            q2, k_ref[0, 0], v_ref[0, 0], do_ref[0, 0], lse_ref[0, 0],
+            q2, k_ref[0, 0], v_ref[0, 0], do_ref[0, 0], lse_scr[...],
             delta_scr[...], q_start, k_start, masked)
 
     @pl.when(ki == n_total - 1)
@@ -376,9 +412,10 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
 
 def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                        dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
-                       block_k: int, scale: float, causal: bool):
+                       block_k: int, scale: float, causal: bool,
+                       packed: bool):
     # grid (b, kv_head, ki, qi), qi innermost. k/v/dk/dv: (1, 1, block_k, D)
-    # at ki; q/do/o: (1, G, block_q, D) at qi; lse: (1, G, block_q, 1).
+    # at ki; q/do/o: (1, G, block_q, D) at qi; lse: (1, G, 1, block_q).
     # delta is recomputed per (g, qi) step — negligible next to the tile's
     # matmuls, and qi is the INNER grid axis so a single-tile cache cannot
     # hold it across the k rows.
@@ -409,7 +446,8 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
         dk_acc, dv_acc = dk_scr[...], dv_scr[...]
         for g in range(group):  # static loop: accumulate the GQA group
             q2 = _prescale_q(q_ref[0, g], scale)
-            dk_c, dv_c = _dkv_tile(q2, k, v, do_ref[0, g], lse_ref[0, g],
+            dk_c, dv_c = _dkv_tile(q2, k, v, do_ref[0, g],
+                                   _read_lse(lse_ref, g, packed),
                                    _delta(do_ref[0, g], o_ref[0, g]),
                                    q_start, k_start, masked)
             dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
@@ -460,13 +498,21 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     group = h // kv_heads
     block_q, block_k = _blocks(s, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
+    packed = _lse_layout(s)  # streaming family only; resident is legacy
+    lse_shape = (b, h, 1, s) if packed else (b, h, s, 1)
+    if packed:
+        lse_spec = pl.BlockSpec((1, 1, 1, block_q),
+                                lambda bi, hi, qi, *_: (bi, hi, 0, qi))
+    else:
+        lse_spec = pl.BlockSpec((1, 1, block_q, 1),
+                                lambda bi, hi, qi, *_: (bi, hi, qi, 0))
     out_shape = [
         jax.ShapeDtypeStruct(qt.shape, q.dtype),
-        jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        jax.ShapeDtypeStruct(lse_shape, jnp.float32),
     ]
     out_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, *_: (bi, hi, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi, *_: (bi, hi, qi, 0)),
+        lse_spec,
     ]
 
     if s <= STREAM_THRESHOLD:
@@ -487,7 +533,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         )(qt, kt, vt)
     else:
         kernel = functools.partial(_fwd_stream_kernel, block_q=block_q,
-                                   block_k=block_k, scale=scale, causal=causal)
+                                   block_k=block_k, scale=scale,
+                                   causal=causal, packed=packed)
         # Causal: grid steps past the diagonal are no-ops in the kernel, so
         # clamp their K/V block index to the last useful one — an unchanged
         # index makes the pipeline skip the HBM fetch entirely.
@@ -533,6 +580,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     dq_bq, dq_bk = _blocks(s, DQ_BLOCK_Q, DQ_BLOCK_K)
     dkv_bq, dkv_bk = _blocks(s, DKV_BLOCK_Q, DKV_BLOCK_K)
     scale = 1.0 / (d ** 0.5)
+    packed = _lse_layout(s)
     # delta (rowwise dO . O) is computed inside the kernels from the do/o
     # tiles (see _delta) — no fp32 materialization at the XLA level.
 
@@ -562,17 +610,22 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
             def dq_kv_idx(bi, hi, qi, ki):
                 return (bi, hi // group, ki, 0)
         kv_spec = pl.BlockSpec((1, 1, dq_bk, d), dq_kv_idx)
-        row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
-                                lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        if packed:
+            row_spec = pl.BlockSpec((1, 1, 1, dq_bq),
+                                    lambda bi, hi, qi, ki: (bi, hi, 0, qi))
+        else:
+            row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
+                                    lambda bi, hi, qi, ki: (bi, hi, qi, 0))
         dq = pl.pallas_call(
             functools.partial(_dq_stream_kernel, block_q=dq_bq, block_k=dq_bk,
-                              scale=scale, causal=causal),
+                              scale=scale, causal=causal, packed=packed),
             grid=(b, h, s // dq_bq, s // dq_bk),
             in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec],
             out_specs=pl.BlockSpec((1, 1, dq_bq, d),
                                    lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
             scratch_shapes=[pltpu.VMEM((dq_bq, d), jnp.float32),
+                            pltpu.VMEM((dq_bq, 1), jnp.float32),
                             pltpu.VMEM((dq_bq, 1), jnp.float32)],
             interpret=interpret,
         )(qt, kt, vt, dot, lse, ot)
@@ -583,7 +636,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     if s <= STREAM_THRESHOLD:
         kv_spec = pl.BlockSpec((1, 1, dkv_bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
         qgrp_spec = pl.BlockSpec((1, group, s, d), lambda bi, hi, ki: (bi, hi, 0, 0))
-        rowgrp_spec = pl.BlockSpec((1, group, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0))
+        rowgrp_spec = pl.BlockSpec((1, group, s, 1),
+                                   lambda bi, hi, ki: (bi, hi, 0, 0))
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, block_q=dkv_bq, scale=scale,
                               causal=causal),
@@ -603,14 +657,23 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
         if causal:  # steps before the diagonal are no-ops: pin their q fetch
             def dkv_q_idx(bi, hi, ki, qi):
                 return (bi, hi, jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
+
+            def dkv_row_idx(bi, hi, ki, qi):
+                return (bi, hi, 0, jnp.maximum(qi, ki * dkv_bk // dkv_bq))
         else:
             def dkv_q_idx(bi, hi, ki, qi):
                 return (bi, hi, qi, 0)
+
+            def dkv_row_idx(bi, hi, ki, qi):
+                return (bi, hi, 0, qi)
         qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
-        rowgrp_spec = pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx)
+        rowgrp_spec = (
+            pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
+            else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
-                              block_k=dkv_bk, scale=scale, causal=causal),
+                              block_k=dkv_bk, scale=scale, causal=causal,
+                              packed=packed),
             grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
             in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
                       qgrp_spec],

@@ -52,7 +52,12 @@ def test_flash_gradients_match_d64():
 
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32),
-                                      (512, 2, 2, 64)])
+                                      (512, 2, 2, 64),
+                                      # non-128-aligned tiles -> the
+                                      # streaming family's LEGACY lse
+                                      # layout (_lse_layout False), which
+                                      # no other case reaches
+                                      (648, 2, 2, 32)])
 def test_streaming_kernels_match(s, h, kv, d, causal, monkeypatch):
     """The long-context streaming kernels (grid-streamed loop operand +
     scratch accumulators; selected above STREAM_THRESHOLD) must agree with
