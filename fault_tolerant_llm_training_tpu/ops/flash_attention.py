@@ -70,6 +70,16 @@ from jax.experimental.pallas import tpu as pltpu
 FWD_BLOCK_Q, FWD_BLOCK_K = 1024, 256
 DQ_BLOCK_Q, DQ_BLOCK_K = 512, 512
 DKV_BLOCK_Q, DKV_BLOCK_K = 512, 1024
+# Very long sequences get their own operating point (tuned at S=32k/64k,
+# B1/H12/D64: -6.6% at 32k, -14.5% at 64k vs the resident tiles — the
+# grid-streamed pipeline prefers larger k-tiles in fwd/dq and a larger
+# q-tile in dkv there). Below LONG_STREAM_THRESHOLD the resident tile
+# sizes measured equal (8k) or clearly better (16k: 132 vs 179 ms), so
+# the streaming kernels keep them.
+LONG_STREAM_THRESHOLD = 32768
+STREAM_FWD_BLOCK_Q, STREAM_FWD_BLOCK_K = 1024, 512
+STREAM_DQ_BLOCK_Q, STREAM_DQ_BLOCK_K = 512, 1024
+STREAM_DKV_BLOCK_Q, STREAM_DKV_BLOCK_K = 1024, 512
 # Above this sequence length the resident kernels' full-row VMEM operands no
 # longer fit (empirically the dk/dv kernel is first to die: 18.4M scoped vmem
 # vs the 16M limit at S=4096, D=64); switch to the streaming kernels.
@@ -133,6 +143,20 @@ def _online_softmax_step(q2, k, v, carry, q_start, k_start, masked):
     return m_new, l_new, acc_new
 
 
+def _active_tiles(s: int):
+    """The (fwd, dq, dkv) (block_q, block_k) pairs the kernels will use at
+    sequence length ``s`` — the single source of truth for the
+    tile-set dispatch, shared by _flash_fwd, _flash_bwd and _lse_layout
+    (which must validate lane alignment against the SAME q-tiles)."""
+    if s >= LONG_STREAM_THRESHOLD:
+        return ((STREAM_FWD_BLOCK_Q, STREAM_FWD_BLOCK_K),
+                (STREAM_DQ_BLOCK_Q, STREAM_DQ_BLOCK_K),
+                (STREAM_DKV_BLOCK_Q, STREAM_DKV_BLOCK_K))
+    return ((FWD_BLOCK_Q, FWD_BLOCK_K),
+            (DQ_BLOCK_Q, DQ_BLOCK_K),
+            (DKV_BLOCK_Q, DKV_BLOCK_K))
+
+
 def _lse_layout(s: int) -> bool:
     """Whether to carry lse packed as (B, H, 1, S) instead of the legacy
     (B, H, S, 1) whose singleton lane the TPU tile pads 128x.
@@ -146,8 +170,8 @@ def _lse_layout(s: int) -> bool:
     in the backward hot loops cost more than the ~1 GB of padding they
     save), while at bs 16 the padding made no wall-clock difference."""
     return (s > STREAM_THRESHOLD
-            and all(_fit_block(s, b) % 128 == 0
-                    for b in (FWD_BLOCK_Q, DQ_BLOCK_Q, DKV_BLOCK_Q)))
+            and all(_fit_block(s, bq) % 128 == 0
+                    for bq, _ in _active_tiles(s)))
 
 
 def _read_lse(ref, g, packed):
@@ -488,7 +512,7 @@ def _blocks(s, block_q, block_k):
     return _fit_block(s, block_q), _fit_block(s, block_k)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, interpret):
     # (B, S, H, D) -> (B, H, S, D) so heads become a grid axis.
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
@@ -496,7 +520,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     b, h, s, d = qt.shape
     kv_heads = kt.shape[1]
     group = h // kv_heads
-    block_q, block_k = _blocks(s, block_q, block_k)
+    block_q, block_k = _blocks(s, *_active_tiles(s)[0])
     scale = 1.0 / (d ** 0.5)
     packed = _lse_layout(s)  # streaming family only; resident is legacy
     lse_shape = (b, h, 1, s) if packed else (b, h, s, 1)
@@ -577,8 +601,9 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     b, h, s, d = qt.shape
     kv_heads = kt.shape[1]
     group = h // kv_heads
-    dq_bq, dq_bk = _blocks(s, DQ_BLOCK_Q, DQ_BLOCK_K)
-    dkv_bq, dkv_bk = _blocks(s, DKV_BLOCK_Q, DKV_BLOCK_K)
+    (_, __), (dq_q, dq_k), (dkv_q, dkv_k) = _active_tiles(s)
+    dq_bq, dq_bk = _blocks(s, dq_q, dq_k)
+    dkv_bq, dkv_bk = _blocks(s, dkv_q, dkv_k)
     scale = 1.0 / (d ** 0.5)
     packed = _lse_layout(s)
     # delta (rowwise dO . O) is computed inside the kernels from the do/o
@@ -699,14 +724,12 @@ def _interpret() -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal=True):
     """Causal flash attention; q (B,S,H,D), k/v (B,S,K,D) -> (B,S,H,D)."""
-    out, _ = _flash_fwd(q, k, v, causal, FWD_BLOCK_Q, FWD_BLOCK_K,
-                        _interpret())
+    out, _ = _flash_fwd(q, k, v, causal, _interpret())
     return out
 
 
 def _flash_attention_fwd(q, k, v, causal):
-    out, lse = _flash_fwd(q, k, v, causal, FWD_BLOCK_Q, FWD_BLOCK_K,
-                          _interpret())
+    out, lse = _flash_fwd(q, k, v, causal, _interpret())
     return out, (q, k, v, out, lse)
 
 

@@ -50,6 +50,7 @@ def test_flash_gradients_match_d64():
     _check_gradients(512, 4, 2, 64)
 
 
+@pytest.mark.parametrize("long_tiles", [False, True])
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32),
                                       (512, 2, 2, 64),
@@ -58,13 +59,19 @@ def test_flash_gradients_match_d64():
                                       # layout (_lse_layout False), which
                                       # no other case reaches
                                       (648, 2, 2, 32)])
-def test_streaming_kernels_match(s, h, kv, d, causal, monkeypatch):
+def test_streaming_kernels_match(s, h, kv, d, causal, long_tiles,
+                                 monkeypatch):
     """The long-context streaming kernels (grid-streamed loop operand +
     scratch accumulators; selected above STREAM_THRESHOLD) must agree with
     the XLA reference, causal and non-causal (the non-causal branch has its
-    own index maps and bounds). Forced on at small S so CI covers them."""
+    own index maps and bounds). Forced on at small S so CI covers them;
+    ``long_tiles`` additionally forces the S>=32k tile set, whose inverted
+    ratios (dq block_k > block_q, dkv block_q > block_k) are geometries the
+    default tiles never produce."""
     import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
     monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
+    if long_tiles:
+        monkeypatch.setattr(fa, "LONG_STREAM_THRESHOLD", 0)
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((2, s, h, d)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
