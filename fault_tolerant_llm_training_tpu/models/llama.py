@@ -88,12 +88,22 @@ class TokenEmbed(nn.Module):
             # full-V (B, S, V) tensor exists per device.
             one_hot = constrain(one_hot, "batch", "seq", "vocab")
             return one_hot @ emb.astype(cfg.dtype)
-        # Gather: pin the OUTPUT to the activation layout so the
-        # partitioner plans the table reshard (feature all-gather) up
-        # front instead of discovering the mismatch at the gather's
-        # consumer and rematerializing (the round-1 dryrun warning on
-        # fsdp/ep meshes).
+        # Gather from a feature-sharded table computes a feature-sharded
+        # output the partitioner cannot reshard to the batch-sharded
+        # activation layout directly; its last resort is replicate-then-
+        # partition plus an involuntary-full-rematerialization warning
+        # (fsdp/ep meshes). When the (B, S, D) output is genuinely small,
+        # stage that same reshard explicitly (replicate, then the
+        # activation constraint re-slices) — identical data movement,
+        # voluntary and warning-free. For large global shapes (long
+        # context, big batch) forcing full replication would defeat the
+        # batch/sequence sharding budget, so the partitioner keeps the
+        # choice. (A feature-replicated TABLE constraint was tried
+        # instead and deadlocks the in-process CPU collectives — see
+        # ROUND_NOTES.md.)
         out = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+        if out.size * out.dtype.itemsize <= 64 * 2**20:
+            out = constrain(out, None, None, None)
         return constrain(out, "batch", "seq", "act_embed")
 
 

@@ -22,7 +22,10 @@ Prints one JSON line per check; exits non-zero on any failure.
 """
 
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
