@@ -45,6 +45,13 @@ from ..parallel.sharding import constrain
 from .configs import TransformerConfig
 
 
+# lecun_normal with the leading expert dim treated as a batch axis: fan_in
+# is the per-expert `in` dim, not E*in (which lecun_normal() would use on an
+# (E, in, out) shape, under-scaling the init std by sqrt(E)).
+_STACKED_INIT = nn.initializers.variance_scaling(
+    1.0, "fan_in", "truncated_normal", batch_axis=(0,))
+
+
 class _StackedKernel(nn.Module):
     """One (E, in, out) expert-stacked kernel, laid out so the param tree
     path (``experts/w{1,2,3}/kernel``) and init distribution match the
@@ -56,9 +63,7 @@ class _StackedKernel(nn.Module):
 
     @nn.compact
     def __call__(self):
-        from .llama import _DENSE_INIT
-
-        return self.param("kernel", _DENSE_INIT, self.shape,
+        return self.param("kernel", _STACKED_INIT, self.shape,
                           self.param_dtype)
 
 

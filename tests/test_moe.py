@@ -90,6 +90,23 @@ def test_sorted_dispatch_matches_capacity_without_drops():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_sorted_init_matches_capacity_init_distribution():
+    """The sorted impl's stacked (E, in, out) kernels initialize with the
+    same per-expert fan-in std as the capacity impl's vmapped per-expert
+    lecun_normal — the expert dim must count as a batch axis, not receptive
+    field (which would under-scale std by sqrt(E))."""
+    cfg = get_config("tiny-moe", moe_impl="sorted", **FP32)
+    x = _x()
+    params = MoEFeedForward(cfg).init(jax.random.PRNGKey(0), x)["params"]
+    cap_params = MoEFeedForward(cfg.replace(moe_impl="capacity")).init(
+        jax.random.PRNGKey(1), x)["params"]
+    for name in ("w1", "w2", "w3"):
+        srt = np.asarray(params["experts"][name]["kernel"], np.float64)
+        cap = np.asarray(cap_params["experts"][name]["kernel"], np.float64)
+        assert srt.shape == cap.shape
+        np.testing.assert_allclose(srt.std(), cap.std(), rtol=0.1)
+
+
 def test_sorted_dispatch_is_dropless_and_differentiable():
     """Under a capacity factor where the capacity impl PROVABLY drops
     (capacity -> 1 slot per expert), the sorted impl still computes every
@@ -234,8 +251,9 @@ def _run_steps(cfg, mesh_kwargs, n_steps=3):
 def test_ep_matches_single_device(eight_devices):
     """Expert-parallel training (experts sharded over 'expert', all-to-all
     from the shardings) reproduces the single-device loss trajectory.
-    Pinned to the capacity impl: 'auto' would pick sorted (dropless, so a
-    different trajectory) on the single-device reference run."""
+    Pinned to the capacity impl: 'auto' currently resolves to capacity
+    everywhere (moe.py), so the pin only guards against a future
+    auto-heuristic change altering the reference trajectory."""
     cfg = get_config("tiny-moe", moe_impl="capacity", **FP32)
     base, _ = _run_steps(cfg, dict(dp=1, devices=[jax.devices()[0]]))
     ep, state = _run_steps(cfg, dict(dp=2, ep=4))
