@@ -49,6 +49,13 @@ class TransformerConfig:
     # fusion (98.3k -> 80.0k tokens/s on the headline bench). Both compute
     # identical functions; models/llama.py has the param-layout converters.
     layer_impl: str = "loop"
+    # Pipeline-parallel schedule (parallel/pipeline.py; only read when the
+    # mesh's pipe axis is >1): "1f1b" interleaves each microbatch's
+    # backward as soon as its loss gradient exists — activation memory
+    # O(pp) with the head+CE fused into the tick loop; "gpipe" is the
+    # store-everything forward scan whose autodiff replays the reverse
+    # pipeline — memory O(microbatches), kept as a fallback/baseline.
+    pp_schedule: str = "1f1b"
     remat: bool = False
     # --- Mixture of Experts (models/moe.py; 0 experts = dense reference
     # FFN). Experts shard over the mesh's 'expert' axis (--ep). ---
@@ -69,6 +76,7 @@ class TransformerConfig:
         # Unknown values would otherwise silently select a default branch
         # (e.g. a layer_impl typo benchmarking the wrong trunk form).
         for field, allowed in (("layer_impl", ("loop", "scan")),
+                               ("pp_schedule", ("1f1b", "gpipe")),
                                ("sp_layout", ("zigzag", "contiguous")),
                                ("attention_impl",
                                 ("auto", "xla", "pallas", "ring")),

@@ -50,7 +50,14 @@ def _block_logits(hidden, w, j, block):
     return jnp.dot(hidden, wj, preferred_element_type=jnp.float32)
 
 
-def _fwd_stats(hidden, w, labels, block):
+def _raw_stats(hidden, w, labels, block):
+    """Blocked online-softmax stats (m, l, picked), all fp32 (B, S).
+
+    Returned un-merged (no ``m + log l``) so a vocab-sharded caller — the
+    1F1B pipeline's in-loop head, parallel/pipeline.py — can fold stats
+    from other shards in with pmax/psum before forming the logsumexp.
+    ``labels`` may be out of range (e.g. offset into another shard's
+    slice); out-of-range rows simply never hit ``picked``."""
     from .cross_entropy import _block_update
 
     b, s, _ = hidden.shape
@@ -69,6 +76,11 @@ def _fwd_stats(hidden, w, labels, block):
                        preferred_element_type=jnp.float32)
         m, l, picked = _block_update(tail, labels, (v // block) * block,
                                      m, l, picked)
+    return m, l, picked
+
+
+def _fwd_stats(hidden, w, labels, block):
+    m, l, picked = _raw_stats(hidden, w, labels, block)
     return m + jnp.log(l), picked
 
 
@@ -88,11 +100,17 @@ def _fx_fwd(hidden, w, labels, block):
     return lse - picked, (hidden, w, labels, lse)
 
 
-def _fx_bwd(block, res, g):
-    hidden, w, labels, lse = res
+def _bwd_accum(hidden, w, labels, lse, gf, block, dw_dtype=None):
+    """Blocked backward of the head+CE: recompute each vocab block's logits,
+    form ``dS_j = gf * (softmax_j - onehot_j)``, and contract immediately
+    into ``(dh, dw)``. ``gf``: fp32 (B, S) per-token cotangent (linear: a
+    zero row yields exactly zero grads). ``dh`` returns fp32; ``dw`` in
+    ``dw_dtype`` (default ``w.dtype``). Shared by the custom VJP below and
+    the 1F1B pipeline's in-loop head (parallel/pipeline.py), whose
+    ``labels`` arrive offset into this shard's local-vocab frame."""
     b, s, d = hidden.shape
     v = w.shape[1]
-    gf = g.astype(jnp.float32)
+    dw_dtype = w.dtype if dw_dtype is None else dw_dtype
 
     def block_ds(j0, vb):
         sl = jnp.dot(
@@ -116,11 +134,11 @@ def _fx_bwd(block, res, g):
         dwj = jnp.einsum("bsd,bsv->dv", hidden, ds,
                          preferred_element_type=jnp.float32)
         dw = jax.lax.dynamic_update_slice_in_dim(
-            dw, dwj.astype(w.dtype), j * block, axis=1)
+            dw, dwj.astype(dw_dtype), j * block, axis=1)
         return dh, dw
 
     dh = jnp.zeros((b, s, d), jnp.float32)
-    dw = jnp.zeros_like(w)
+    dw = jnp.zeros(w.shape, dw_dtype)
     dh, dw = jax.lax.fori_loop(0, v // block, body, (dh, dw))
     if v % block:
         j0 = (v // block) * block
@@ -131,7 +149,13 @@ def _fx_bwd(block, res, g):
         dw = jax.lax.dynamic_update_slice_in_dim(
             dw, jnp.einsum("bsd,bsv->dv", hidden, ds,
                            preferred_element_type=jnp.float32
-                           ).astype(w.dtype), j0, axis=1)
+                           ).astype(dw_dtype), j0, axis=1)
+    return dh, dw
+
+
+def _fx_bwd(block, res, g):
+    hidden, w, labels, lse = res
+    dh, dw = _bwd_accum(hidden, w, labels, lse, g.astype(jnp.float32), block)
     return dh.astype(hidden.dtype), dw, None
 
 

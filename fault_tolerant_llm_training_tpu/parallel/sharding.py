@@ -52,14 +52,18 @@ def suspend_constraints():
 LOGICAL_RULES: Dict[str, object] = {
     "batch": ("data", "fsdp"),
     "seq": "sequence",
-    # vocab shards over tensor AND pipe: on a pp mesh every stage stores
+    # vocab shards over pipe AND tensor: on a pp mesh every stage stores
     # only its vocab slice of the embed table / head weight and computes
     # only its slice of the (B, S, V) logits — one head matmul total
     # across the mesh instead of P replicated ones (the round-1 pipeline
     # recomputed the model's largest matmul on every stage). The CE is
     # gather-free (training/step.py) so vocab-sharded logits reduce with
-    # small (B, S) collectives, never an all-gather of logits.
-    "vocab": ("tensor", "pipe"),
+    # small (B, S) collectives, never an all-gather of logits. 'pipe'
+    # MAJOR: the 1F1B pipeline's in-loop head (parallel/pipeline.py) views
+    # the weight as (D, P, V/P) under a partial-manual shard_map, which is
+    # a reshard-free reshape only when each stage's slice is contiguous
+    # (pipe outermost); the tensor sub-sharding stays inside each slice.
+    "vocab": ("pipe", "tensor"),
     "embed": "fsdp",
     # activations keep their feature dim replicated (FSDP shards params, not
     # activations; 'embed' -> fsdp applies to parameter matrices only)
@@ -107,7 +111,7 @@ def _fit_spec(spec: P, shape, mesh) -> P:
     """Drop mesh axes a dimension cannot actually be sharded over.
 
     An indivisible dim (e.g. the byte tokenizer's 259-entry vocab over a
-    ('tensor', 'pipe') product) would be a hard pjit error; degrading that
+    ('pipe', 'tensor') product) would be a hard pjit error; degrading that
     dim to the divisible prefix of its axes (possibly replicated) is always
     semantically valid — the same per-axis degrade the ring attention op
     applies to its batch axes. Dropping an axis on a non-trivial dim is

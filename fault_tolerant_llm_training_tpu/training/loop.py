@@ -189,15 +189,15 @@ class Trainer:
             dtype=dtype, param_dtype=param_dtype,
             attention_impl=cfg.attention_impl, embed_impl=cfg.embed_impl,
             sp_layout=cfg.sp_layout, layer_impl=cfg.layer_impl,
-            remat=cfg.remat, **moe_over)
+            pp_schedule=cfg.pp_schedule, remat=cfg.remat, **moe_over)
         if cfg.ep > 1 and not self.model_config.moe_experts:
             raise ValueError("--ep needs an MoE model (--model tiny-moe or "
                              "--moe-experts N)")
         if self.model_config.moe_experts:
-            if cfg.pp > 1:
-                raise ValueError("--pp with an MoE model is not supported "
-                                 "(the pipeline forward drops the router "
-                                 "aux loss)")
+            if cfg.pp > 1 and cfg.pp_schedule == "gpipe":
+                raise ValueError("--pp-schedule gpipe with an MoE model is "
+                                 "not supported (its forward drops the "
+                                 "router aux loss); use 1f1b (the default)")
             if self.model_config.moe_experts % max(cfg.ep, 1):
                 raise ValueError(
                     f"moe_experts {self.model_config.moe_experts} not "

@@ -114,12 +114,15 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
     cfg = getattr(model, "cfg", None)
     if (cfg is not None and cfg.layer_impl == "scan"
             and mesh_axis_size("pipe") > 1):
-        if cfg.moe_experts:
-            # guard at the point of the drop, not only in the Trainer: the
-            # pipelined forward cannot return the routers' sown aux losses
+        if cfg.moe_experts and train:
+            # Only the GPipe-schedule TRAIN path lands here (1F1B trains
+            # via pipeline_value_and_grad, which carries the aux; eval
+            # reports pure CE and needs no aux). Guard at the point of the
+            # drop, not only in the Trainer.
             raise NotImplementedError(
-                "pipeline parallelism with an MoE model would silently "
-                "drop the router load-balancing loss")
+                "--pp-schedule gpipe with an MoE model would silently "
+                "drop the router load-balancing loss; use the 1f1b "
+                "schedule (the default)")
         from ..parallel.pipeline import pipeline_apply
         logits = pipeline_apply(model, params, inputs,
                                 microbatches=microbatches)
@@ -239,18 +242,29 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     def loss_fn(params, inputs, labels):
         return model_loss(model, params, inputs, labels, microbatches)
 
+    cfg = getattr(model, "cfg", None)
+    if (cfg is not None and cfg.layer_impl == "scan"
+            and mesh_axis_size("pipe") > 1 and cfg.pp_schedule == "1f1b"):
+        # 1F1B assembles gradients explicitly inside its tick loop
+        # (parallel/pipeline.py) — autodiff never sees the schedule.
+        from ..parallel.pipeline import pipeline_value_and_grad
+
+        def value_and_grad(params, inputs, labels):
+            return pipeline_value_and_grad(model, params, inputs, labels,
+                                           microbatches=microbatches)
+    else:
+        value_and_grad = jax.value_and_grad(loss_fn, has_aux=True)
+
     def accum_value_and_grad(params, inputs, labels):
         if grad_accum <= 1:
-            return jax.value_and_grad(loss_fn, has_aux=True)(
-                params, inputs, labels)
+            return value_and_grad(params, inputs, labels)
         b = inputs.shape[0] // grad_accum
         sl_inputs = inputs.reshape(grad_accum, b, *inputs.shape[1:])
         sl_labels = labels.reshape(grad_accum, b, *labels.shape[1:])
 
         def body(carry, sl):
             g_acc, nll_acc, n_acc = carry
-            (loss, n), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, sl[0], sl[1])
+            (loss, n), grads = value_and_grad(params, sl[0], sl[1])
             nf = n.astype(jnp.float32)
             g_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32) * nf, g_acc, grads)

@@ -50,7 +50,8 @@ class TrainConfig:
     tp: int = 1  # tensor-parallel mesh size
     sp: int = 1  # sequence-parallel (ring attention) mesh size
     pp: int = 1  # pipeline-parallel mesh size (needs --layer-impl scan)
-    microbatches: int = 0  # GPipe microbatches (0 = one per pipeline stage)
+    microbatches: int = 0  # pipeline microbatches (0 = one per stage)
+    pp_schedule: str = "1f1b"  # 1f1b (O(pp) activation memory) | gpipe
     ep: int = 1  # expert-parallel mesh size (needs an MoE model)
     # MoE overrides; None = keep the model preset's values
     moe_experts: Optional[int] = None
@@ -153,7 +154,12 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--pp", type=int, default=1,
                         help="pipeline-parallel size (needs --layer-impl scan)")
     parser.add_argument("--microbatches", type=int, default=0,
-                        help="GPipe microbatches (0 = one per pipeline stage)")
+                        help="pipeline microbatches (0 = one per stage)")
+    parser.add_argument("--pp-schedule", type=str, default="1f1b",
+                        choices=["1f1b", "gpipe"],
+                        help="pipeline schedule: 1f1b interleaves each "
+                             "microbatch's backward (O(pp) activation "
+                             "memory); gpipe stores all microbatches")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel size (needs an MoE model, "
                              "e.g. --model tiny-moe or --moe-experts N)")
