@@ -29,11 +29,14 @@ stats merge with small (mb, S) psums over 'pipe' — the same algebra as
 ops/fused_ce.py, which it reuses), so ``m``'s backward starts ``P`` ticks
 later while later microbatches are still in forward flight. Consequences:
 
-- activation memory is O(P): each stage stashes at most ``2P-1`` microbatch
-  *inputs* (a ring buffer) and recomputes its block internals during the
-  backward tick (full-stage rematerialization — the same fwd+bwd work as
-  GPipe-with-remat, ~4/3 the FLOPs of GPipe-without-remat), instead of the
-  GPipe schedule's autodiff storing all ``M+P-1`` ticks of residuals;
+- trunk activation memory is O(P) in microbatches: each stage stashes at
+  most ``2P-1`` microbatch *inputs* (a ring buffer) and recomputes its
+  block internals during the backward tick (full-stage rematerialization
+  — the same fwd+bwd work as GPipe-with-remat, ~4/3 the FLOPs of
+  GPipe-without-remat), instead of the GPipe schedule's autodiff storing
+  all ``M+P-1`` ticks of residuals. (The embed boundary and its
+  cotangent remain O(B) full-batch buffers — they exist under any
+  schedule, since embed and its backward run out-of-line.);
 - logits exist only per-microbatch and per-vocab-shard: (mb, S, block)
   fp32 transients instead of the (B, S, V/P) fp32 tensor the out-of-line
   head materializes — at the reference's 131k vocab this is the larger win;
@@ -194,7 +197,8 @@ def pipeline_value_and_grad(model, params, tokens, labels, mesh=None,
     - backward of ``m`` at stage ``s``: ``t = m + 2P - 1 - s``
 
     so ``T = M + 2P - 1`` ticks total and a stage holds at most ``2P-1``
-    stashed microbatch inputs — O(P), independent of M. Loss semantics
+    stashed microbatch inputs — O(P) trunk residuals, independent of M
+    (the embed boundary/cotangent buffers stay O(B)). Loss semantics
     match grad accumulation (training/step.py): per-token 1/N cotangents
     with N the global valid count, and per-microbatch MoE aux weighted by
     the microbatch's valid tokens.
@@ -205,10 +209,9 @@ def pipeline_value_and_grad(model, params, tokens, labels, mesh=None,
     from ..ops.cross_entropy import DEFAULT_BLOCK
     from ..ops.fused_ce import _bwd_accum, _raw_stats
     from ..parallel.sharding import (
-        _fit_spec,
         constrain,
-        logical_pspec,
         suspend_constraints,
+        vocab_shard_axes,
     )
     from ..training.step import IGNORE_INDEX
 
@@ -278,10 +281,12 @@ def pipeline_value_and_grad(model, params, tokens, labels, mesh=None,
     # any 'tensor' sub-sharding stays auto inside the slice.
     w = params["output"]["kernel"]
     v = w.shape[1]
-    fitted = _fit_spec(logical_pspec("embed", "vocab"), w.shape, mesh)
-    vaxes = fitted[1]
-    vaxes = vaxes if isinstance(vaxes, tuple) else (
-        (vaxes,) if vaxes else ())
+    vaxes = vocab_shard_axes(w.shape, mesh)
+    # When the vocab dim is indivisible by pp (degenerate configs only —
+    # every real preset's vocab divides the pipe sizes in use), the weight
+    # arrives pipe-replicated and every stage runs the full-vocab tail
+    # redundantly (P× head FLOPs). Accepted: gating the tail per stage
+    # would need divergent lax.conds around auto-axis collectives.
     pipe_shards = pp if "pipe" in vaxes else 1
     tensor_on_vocab = "tensor" in vaxes
     vl = v // pipe_shards

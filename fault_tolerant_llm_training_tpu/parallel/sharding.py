@@ -171,6 +171,18 @@ def logical_pspec(*logical_axes) -> P:
     return _resolve(logical_axes)
 
 
+def vocab_shard_axes(w_shape, mesh) -> Tuple[str, ...]:
+    """Mesh axes that actually shard the vocab dim of a (D, V) weight on
+    ``mesh`` (after the :func:`_fit_spec` divisibility degrade), in
+    sharding-major order. The single source of truth for every consumer
+    that hand-schedules over the vocab sharding (the fused sharded CE in
+    ops/fused_ce.py and the 1F1B pipeline's in-loop head) — their offset
+    math must agree or labels land in the wrong shard."""
+    fitted = _fit_spec(logical_pspec("embed", "vocab"), w_shape, mesh)
+    axes = fitted[1]
+    return axes if isinstance(axes, tuple) else ((axes,) if axes else ())
+
+
 def batch_pspec() -> P:
     """Batches: (B, S) sharded batch->data+fsdp, seq->sequence."""
     return _resolve(("batch", "seq"))

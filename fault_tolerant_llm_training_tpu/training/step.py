@@ -138,18 +138,23 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
         inputs, labels = inputs[:, perm], labels[:, perm]
         args = (jnp.broadcast_to(perm[None, :], inputs.shape),)
     from ..ops.cross_entropy import AUTO_THRESHOLD
-    from ..ops.fused_ce import AUTO_MIN_BYTES, fused_head_xent
+    from ..ops.fused_ce import (
+        AUTO_MIN_BYTES,
+        fused_head_xent,
+        sharded_fused_head_xent,
+    )
     from ..parallel.sharding import shard_size
-    # Per-DEVICE logits + cotangent footprint: batch and seq shard over
-    # their mesh axes, so the global product overestimates on multi-chip
-    # meshes (OOM is a per-device phenomenon).
+    # Per-DEVICE logits + cotangent footprint: batch, seq AND vocab shard
+    # over their mesh axes, so the global product overestimates on
+    # multi-chip meshes (OOM is a per-device phenomenon).
+    vocab_shards = (shard_size(cfg.vocab_size, "vocab")
+                    if cfg is not None else 1)
     logits_bytes = (
         inputs.shape[0] // shard_size(inputs.shape[0], "batch")
         * (inputs.shape[1] // shard_size(inputs.shape[1], "seq"))
-        * (cfg.vocab_size if cfg is not None else 0) * 6)
+        * (cfg.vocab_size // vocab_shards if cfg is not None else 0) * 6)
     fused = (cfg is not None and cfg.vocab_size >= AUTO_THRESHOLD
-             and logits_bytes > AUTO_MIN_BYTES
-             and shard_size(cfg.vocab_size, "vocab") == 1)
+             and logits_bytes > AUTO_MIN_BYTES)
 
     # One forward (with the MoE routers' sown aux when training), one loss
     # assembly — the fused path only changes WHICH function maps the
@@ -165,14 +170,17 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
         out = model.apply({"params": params}, inputs, *args, method=method)
         aux = None
     if fused:
-        # Large unsharded vocab whose logits + cotangent would not fit:
+        # Large vocab whose per-device logits + cotangent would not fit:
         # block the head matmul into the loss (ops/fused_ce.py) — logits
-        # never materialize in any dtype. See AUTO_MIN_BYTES for the
-        # measured tradeoff.
+        # never materialize in any dtype. A sharded vocab axis (tensor /
+        # pipe meshes) takes the shard_map form whose online stats fold
+        # across the shards. See AUTO_MIN_BYTES for the measured tradeoff.
         head_w = params["output"]["kernel"].astype(cfg.dtype)
         safe = jnp.where(labels == IGNORE_INDEX, 0, labels)
-        nll = fused_head_xent(out, head_w, safe,
-                              min(8192, head_w.shape[1]))
+        xent = (sharded_fused_head_xent if vocab_shards > 1
+                else fused_head_xent)
+        nll = xent(out, head_w, safe,
+                   min(8192, head_w.shape[1] // vocab_shards))
         loss, num_valid = masked_mean_nll(nll, labels)
     else:
         loss, num_valid = cross_entropy_loss(out, labels)
