@@ -250,6 +250,7 @@ class Trainer:
         self._save_job_id = JOBID or "local"
         self.ckpt_mngr = CheckpointManager(cfg.checkpoint_path,
                                            self._save_job_id)
+        self._log_checkpoint_budget()
 
         self.batch_sharding = NamedSharding(self.mesh, batch_pspec())
         self._jit_step = jax.jit(
@@ -323,6 +324,43 @@ class Trainer:
                 f"TrainState needs ~{per_device / 1e9:.1f} GB per device but "
                 f"the device reports {limit / 1e9:.1f} GB; expect an OOM — "
                 f"shard more (--fsdp/--tp) or pick a smaller --model")
+
+    def _log_checkpoint_budget(self) -> None:
+        """The startup deadline check (SURVEY §5.3, §7.3 #2): estimate the
+        fault-path save time from this host's state bytes and a one-shot
+        write-throughput probe of the checkpoint filesystem, and compare
+        it against the scheduler's USR1 lead. The whole framework exists
+        to honor that lead — discovering a blown budget at the first
+        preemption is too late. Numbers are logged every run so operators
+        can track drift (e.g. a slower Lustre mount)."""
+        from ..checkpoint.manager import (
+            estimate_save_seconds,
+            measure_write_throughput,
+            state_bytes,
+        )
+
+        total = state_bytes(self.abstract_state)
+        # Per-host share: every host writes only its own device shards
+        # (Orbax per-host parallel writes); even sharding assumed.
+        per_host = total // max(jax.process_count(), 1)
+        try:
+            tput = measure_write_throughput(self.ckpt_mngr.directory)
+        except OSError as e:
+            logger.warning(f"Checkpoint budget | write probe failed: {e}")
+            return
+        est = estimate_save_seconds(per_host, tput)
+        lead = self.cfg.signal_lead_seconds
+        logger.info(
+            f"Checkpoint budget | state {total / 1e9:.2f} GB "
+            f"({per_host / 1e9:.2f} GB/host) | disk {tput / 1e9:.2f} GB/s "
+            f"| est save {est:.0f} s | signal lead {lead} s")
+        if est > lead:
+            logger.warning(
+                f"Checkpoint budget EXCEEDED: estimated fault-path save "
+                f"{est:.0f} s > the {lead} s signal lead — a preemption "
+                f"may outrun the save. Shard over more hosts, use faster "
+                f"checkpoint storage, or raise --signal-lead-seconds to "
+                f"match the scheduler's --signal=USR1@N.")
 
     def _setup_check(self) -> None:
         """Phase-boundary signal check during setup.
@@ -502,6 +540,16 @@ class Trainer:
         step = int(jax.device_get(self.state.step))
         data_state = self._last_data_state or self.loader.get_state()
         self.ckpt_mngr.save(step, self.state, data_state, wait=wait)
+        if wait and self.ckpt_mngr.last_save_seconds is not None:
+            # observed wall for blocking (fault-path) saves: the number the
+            # startup budget estimate exists to predict
+            from ..checkpoint.manager import state_bytes
+
+            secs = self.ckpt_mngr.last_save_seconds
+            total = state_bytes(self.state)
+            logger.info(f"Checkpoint write | {total / 1e9:.2f} GB in "
+                        f"{secs:.1f} s ({total / 1e9 / max(secs, 1e-6):.2f} "
+                        f"GB/s)")
         return step
 
     def close(self) -> None:

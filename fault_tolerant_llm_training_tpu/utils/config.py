@@ -82,6 +82,11 @@ class TrainConfig:
     # running it every step would force inflight=1 on a pod; every N steps
     # bounds signal latency to N*step_time (vs the 120 s USR1 lead).
     signal_sync_frequency: int = 5
+    # The scheduler's pre-termination warning lead (seconds): Slurm arms
+    # SIGUSR1 this long before the time limit (ref train.sh:12,
+    # --signal=USR1@120). The trainer checks its estimated checkpoint
+    # save time against this budget at startup (checkpoint/manager.py).
+    signal_lead_seconds: int = 120
     profile_dir: str = ""  # jax.profiler trace output; "" = off
     resubmit_command: str = ""  # override for tests; default: sbatch $WORKDIR/train.sh
     distributed: bool = False  # call jax.distributed.initialize() (multi-host pods)
@@ -230,6 +235,11 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--prefetch", type=int, default=2)
     parser.add_argument("--inflight", type=int, default=2)
     parser.add_argument("--signal-sync-frequency", type=int, default=5)
+    parser.add_argument("--signal-lead-seconds", type=int, default=120,
+                        help="scheduler pre-termination warning lead (the "
+                             "USR1@N contract); the startup checkpoint-"
+                             "budget check warns when the estimated save "
+                             "exceeds it")
     parser.add_argument("--profile-dir", type=str, default="")
     parser.add_argument("--resubmit-command", type=str, default="",
                         help="Override the self-resubmit command (tests); "

@@ -179,6 +179,11 @@ def test_injected_error_saves_no_resubmit_then_bitexact_resume(tmp_path, parquet
     assert "[EXIT HANDLER] Error during training encountered, saving checkpoint." in out
     assert "Checkpoint saved at step" in out
     assert "sbatch requeued" not in out  # error path never resubmits
+    # the startup budget line (est save vs USR1 lead, checkpoint/manager.py)
+    # and the fault path's observed write log
+    assert "Checkpoint budget | state" in out
+    assert "signal lead 120 s" in out
+    assert "Checkpoint write |" in out
     ckpt_dir = tmp_path / "ckpts" / "checkpoint_j1"
     assert ckpt_dir.exists()
 
