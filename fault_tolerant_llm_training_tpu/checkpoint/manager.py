@@ -71,7 +71,12 @@ def measure_write_throughput(directory: str,
 
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f".write_probe.{jax.process_index()}")
-    payload = np.arange(probe_bytes // 8, dtype=np.uint64)
+    # Genuinely random payload: a counter pattern compresses several-fold
+    # on filesystems with transparent compression (ZFS lz4 etc.), which
+    # would inflate the measured throughput and silently suppress the
+    # budget warning for the incompressible real weights.
+    payload = np.random.default_rng(0).integers(
+        0, np.iinfo(np.uint64).max, probe_bytes // 8, dtype=np.uint64)
     try:
         t0 = time.monotonic()
         with open(path, "wb") as f:

@@ -11,9 +11,11 @@ scores per tile from the saved logsumexp, so the backward is O(S) memory too
 
 GQA: the kernels map query head ``h`` to KV head ``h // (H // K)`` in the
 BlockSpec index map — KV are never repeated in memory (the reference's
-``repeat_kv`` at model.py:129-138 materializes the expansion). The dk/dv
-kernel runs one grid step per *KV* head and accumulates its query-head group
-in-kernel, so gradients are written at native KV-head granularity.
+``repeat_kv`` at model.py:129-138 materializes the expansion). dk/dv are
+written at native KV-head granularity: the resident fused backward emits
+its full-row scratch once per KV-head span, and the streaming dk/dv
+kernel runs one grid step per *KV* head, accumulating its query-head
+group in-kernel.
 
 VPU economy (attention at head_dim 64 is VPU-bound on TPU, not MXU-bound):
 
@@ -44,10 +46,16 @@ boundary.
 
 Two kernel families, dispatched on sequence length:
 
-- **Resident** (S <= STREAM_THRESHOLD): the non-grid operand (K/V for
-  fwd/dq, the q/do rows for dk/dv) sits whole in VMEM and an in-kernel
+- **Resident** (S <= STREAM_THRESHOLD): the non-grid operand (K/V, and
+  the dk/dv gradient accumulators) sits whole in VMEM and an in-kernel
   fori_loop walks it. Fastest at moderate S — no per-block pipeline
   boundaries — but VMEM-bound: the resident rows grow linearly with S.
+  The backward is ONE fused kernel (_bwd_fused_kernel) producing dq, dk
+  and dv from a single pass over the causal tile triangle — the split
+  FA2 scheme recomputes the VPU-bound softmax core (scores, exp2,
+  dO @ V^T, dS) twice per tile, once in dq and once in dk/dv; fusing it
+  measured +10.9% on the headline bench (98.2k -> 109.0k tokens/s) and
+  +9.4% at bs 16 (BASELINE.md round 3).
 - **Streaming** (S > STREAM_THRESHOLD): the loop moves into the grid's
   innermost dimension; the online-softmax / gradient accumulators live in
   VMEM scratch that persists across grid steps, and every operand is a
@@ -272,24 +280,68 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0, 0] = (m + jnp.log2(l))[:, None]  # base-2, internal only
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, dq_ref, *,
-               block_k: int, scale: float, causal: bool):
-    # q/do/o/dq: (1, 1, block_q, D); k/v: (1, 1, S, D); lse: (1, 1, block_q, 1)
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      block_k: int, scale: float, causal: bool, group: int):
+    """Fused resident backward: dq, dk and dv from ONE pass over the score
+    tiles.
+
+    The split FA2 kernels each recompute the tile's scores, probabilities
+    (exp2) and dP = dO @ V^T — i.e. the whole VPU-bound softmax core runs
+    twice per (q, k) tile. Here the grid walks q tiles (like the dq
+    kernel); dq accumulates per grid step, while dk/dv accumulate into
+    full-row fp32 VMEM scratch that persists across the (GQA group x
+    q-tile) span of one KV head and is emitted once at the span's last
+    step. Per tile: 5 matmuls + 1 exp pass, vs the split kernels' 7 + 2.
+    Resident family only — the scratch is (S, D) fp32, which is exactly
+    the full-row VMEM residency that defines the family.
+
+    Grid (b, h, qi), qi innermost. q/do/o/dq: (1, 1, block_q, D) at qi;
+    k/v: (1, 1, S, D) and dk/dv out: (1, 1, S, D) at KV head h // group
+    (their blocks are revisited across the span, written back on the last
+    step); lse: (1, 1, block_q, 1).
+    """
+    hi = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_qi = pl.num_programs(2)
+
+    @pl.when((qi == 0) & (hi % group == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
     q2 = _prescale_q(q_ref[0, 0], scale)
     do = do_ref[0, 0]
     lse = lse_ref[0, 0]
     delta = _delta(do, o_ref[0, 0])
     block_q, d = q2.shape
     s_k = k_ref.shape[2]
-    q_start = pl.program_id(2) * block_q
+    q_start = qi * block_q
     n_full, n_total = _k_block_bounds(q_start, block_q, s_k, block_k, causal)
 
     def body(j, dq_acc, masked):
         k_start = j * block_k
         k = k_ref[0, 0, pl.ds(k_start, block_k), :]
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
-        return dq_acc + _dq_tile(q2, k, v, do, lse, delta, q_start, k_start,
-                                 masked)
+        s = _scores(q2, k, q_start, k_start, masked)
+        p = jnp.exp2(s - lse)
+        dp = jax.lax.dot_general(  # dO @ V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dv_scr[pl.ds(k_start, block_k), :] = (
+            dv_scr[pl.ds(k_start, block_k), :]
+            + jax.lax.dot_general(  # P^T @ dO
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        dk_scr[pl.ds(k_start, block_k), :] = (
+            dk_scr[pl.ds(k_start, block_k), :]
+            + jax.lax.dot_general(  # dS^T @ Q2
+                ds.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        return dq_acc + jax.lax.dot_general(  # dS @ K
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, n_full, functools.partial(body, masked=False),
                            jnp.zeros((block_q, d), jnp.float32))
@@ -297,53 +349,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, dq_ref, *,
                            functools.partial(body, masked=causal), dq)
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
-
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
-                dk_ref, dv_ref, *, block_q: int, scale: float, causal: bool):
-    # Grid step = one KV head. k/v/dk/dv: (1, 1, block_k, D);
-    # q/do/o: (1, G, S, D) — this KV head's G query heads; lse: (1, G, S, 1).
-    # delta is recomputed per (g, q-block) each grid step: the (bq, D)
-    # multiply-reduce is negligible next to the tile's four matmuls, and
-    # caching it across k-steps would need a cross-row scratch protocol.
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    block_k, d = k.shape
-    group = q_ref.shape[1]
-    s_q = q_ref.shape[2]
-    k_start = pl.program_id(2) * block_k
-    n_q_blocks = s_q // block_q
-    # Causal split of the q range: q-blocks strictly before this k-block
-    # contribute nothing; blocks straddling the diagonal need the mask;
-    # q-blocks whose first row is >= k_start + block_k - 1 are full.
-    if causal:
-        j_start = k_start // block_q
-        j_full = jnp.minimum(
-            (k_start + block_k - 1 + block_q - 1) // block_q, n_q_blocks)
-    else:
-        j_start, j_full = 0, 0
-
-    def body(j, carry, masked):
-        dk_acc, dv_acc = carry
-        q_start = j * block_q
-        for g in range(group):  # static loop: accumulate the GQA group
-            q2 = _prescale_q(q_ref[0, g, pl.ds(q_start, block_q), :], scale)
-            do = do_ref[0, g, pl.ds(q_start, block_q), :]
-            lse = lse_ref[0, g, pl.ds(q_start, block_q), :]
-            delta = _delta(do, o_ref[0, g, pl.ds(q_start, block_q), :])
-            dk_c, dv_c = _dkv_tile(q2, k, v, do, lse, delta, q_start,
-                                   k_start, masked)
-            dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
-        return dk_acc, dv_acc
-
-    init = (jnp.zeros((block_k, d), jnp.float32),
-            jnp.zeros((block_k, d), jnp.float32))
-    carry = jax.lax.fori_loop(
-        j_start, j_full, functools.partial(body, masked=causal), init)
-    dk, dv = jax.lax.fori_loop(
-        j_full if causal else 0, n_q_blocks,
-        functools.partial(body, masked=False), carry)
-    dk_ref[0, 0] = (dk * LN2).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when((qi == n_qi - 1) & (hi % group == group - 1))
+    def _emit():
+        dk_ref[0, 0] = (dk_scr[...] * LN2).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _stream_bounds(ki, q_start, block_q, n_k, block_k, causal):
@@ -610,20 +619,31 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     # tiles (see _delta) — no fp32 materialization at the XLA level.
 
     if s <= STREAM_THRESHOLD:
+        # Fused single-pass backward (see _bwd_fused_kernel): dq, dk, dv
+        # from one walk of the causal tile triangle.
         q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
         kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
         row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
                                 lambda bi, hi, qi: (bi, hi, qi, 0))
-        dq = pl.pallas_call(
-            functools.partial(_dq_kernel, block_k=dq_bk, scale=scale,
-                              causal=causal),
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, block_k=dq_bk, scale=scale,
+                              causal=causal, group=group),
             grid=(b, h, s // dq_bq),
             in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, q_spec],
-            out_specs=pl.BlockSpec((1, 1, dq_bq, d),
-                                   lambda bi, hi, qi: (bi, hi, qi, 0)),
-            out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            out_specs=[pl.BlockSpec((1, 1, dq_bq, d),
+                                    lambda bi, hi, qi: (bi, hi, qi, 0)),
+                       kv_full, kv_full],
+            out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype),
+                       jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                       jax.ShapeDtypeStruct(vt.shape, v.dtype)],
+            scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
+                            pltpu.VMEM((s, d), jnp.float32)],
             interpret=interpret,
         )(qt, kt, vt, dot, lse, ot)
+        dq_out = jnp.transpose(dq, (0, 2, 1, 3))
+        dk_out = jnp.transpose(dk, (0, 2, 1, 3))
+        dv_out = jnp.transpose(dv, (0, 2, 1, 3))
+        return dq_out, dk_out, dv_out
     else:
         q_spec = pl.BlockSpec((1, 1, dq_bq, d),
                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
@@ -657,60 +677,42 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
 
     # Grid over KV heads: block index maps pick up this head's group of G
     # query heads ((1, G, ...) blocks); dk/dv land at KV-head granularity —
-    # no (B, H, S, D) expansion buffer.
-    if s <= STREAM_THRESHOLD:
-        kv_spec = pl.BlockSpec((1, 1, dkv_bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
-        qgrp_spec = pl.BlockSpec((1, group, s, d), lambda bi, hi, ki: (bi, hi, 0, 0))
-        rowgrp_spec = pl.BlockSpec((1, group, s, 1),
-                                   lambda bi, hi, ki: (bi, hi, 0, 0))
-        dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, block_q=dkv_bq, scale=scale,
-                              causal=causal),
-            grid=(b, kv_heads, s // dkv_bk),
-            in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                      qgrp_spec],
-            out_specs=[kv_spec, kv_spec],
-            out_shape=[
-                jax.ShapeDtypeStruct(kt.shape, k.dtype),
-                jax.ShapeDtypeStruct(vt.shape, v.dtype),
-            ],
-            interpret=interpret,
-        )(qt, kt, vt, dot, lse, ot)
+    # no (B, H, S, D) expansion buffer. (Streaming only: the resident
+    # family returned above with dk/dv from the fused kernel.)
+    kv_spec = pl.BlockSpec((1, 1, dkv_bk, d),
+                           lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    if causal:  # steps before the diagonal are no-ops: pin their q fetch
+        def dkv_q_idx(bi, hi, ki, qi):
+            return (bi, hi, jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
+
+        def dkv_row_idx(bi, hi, ki, qi):
+            return (bi, hi, 0, jnp.maximum(qi, ki * dkv_bk // dkv_bq))
     else:
-        kv_spec = pl.BlockSpec((1, 1, dkv_bk, d),
-                               lambda bi, hi, ki, qi: (bi, hi, ki, 0))
-        if causal:  # steps before the diagonal are no-ops: pin their q fetch
-            def dkv_q_idx(bi, hi, ki, qi):
-                return (bi, hi, jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
+        def dkv_q_idx(bi, hi, ki, qi):
+            return (bi, hi, qi, 0)
 
-            def dkv_row_idx(bi, hi, ki, qi):
-                return (bi, hi, 0, jnp.maximum(qi, ki * dkv_bk // dkv_bq))
-        else:
-            def dkv_q_idx(bi, hi, ki, qi):
-                return (bi, hi, qi, 0)
-
-            def dkv_row_idx(bi, hi, ki, qi):
-                return (bi, hi, 0, qi)
-        qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
-        rowgrp_spec = (
-            pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
-            else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
-        dk, dv = pl.pallas_call(
-            functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
-                              block_k=dkv_bk, scale=scale, causal=causal,
-                              packed=packed),
-            grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
-            in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                      qgrp_spec],
-            out_specs=[kv_spec, kv_spec],
-            out_shape=[
-                jax.ShapeDtypeStruct(kt.shape, k.dtype),
-                jax.ShapeDtypeStruct(vt.shape, v.dtype),
-            ],
-            scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
-                            pltpu.VMEM((dkv_bk, d), jnp.float32)],
-            interpret=interpret,
-        )(qt, kt, vt, dot, lse, ot)
+        def dkv_row_idx(bi, hi, ki, qi):
+            return (bi, hi, 0, qi)
+    qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
+    rowgrp_spec = (
+        pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
+        else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
+                          block_k=dkv_bk, scale=scale, causal=causal,
+                          packed=packed),
+        grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
+        in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
+                  qgrp_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
+                        pltpu.VMEM((dkv_bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, ot)
     dq_out = jnp.transpose(dq, (0, 2, 1, 3))
     dk_out = jnp.transpose(dk, (0, 2, 1, 3))
     dv_out = jnp.transpose(dv, (0, 2, 1, 3))
