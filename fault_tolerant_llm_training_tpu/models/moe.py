@@ -124,7 +124,11 @@ class MoEFeedForward(nn.Module):
             if mesh_axis_size("expert") > 1:
                 raise ValueError(
                     "moe_impl='sorted' is single-expert-group only; use "
-                    "the capacity impl under --ep")
+                    "the capacity impl under --ep. Measured rejection "
+                    "(BASELINE.md round 3): a dropless exchange needs "
+                    "worst-case-padded all-to-all buffers on a static-"
+                    "shape compiler, and shard-local ragged GEMM "
+                    "throughput collapses with the expert shard size")
             return self._sorted_dispatch(x, top_w, top_e)
 
         capacity = max(1, math.ceil(cfg.moe_capacity_factor * k * s / E))

@@ -5,9 +5,10 @@ TPU-native replacement for the reference's fused-kernel dependency
 on CUDA comes from the NGC container. Here the kernel is first-party:
 an online-softmax tiled forward that never materializes the (S, S) score
 matrix — O(S) memory, q-tiles streamed through VMEM, scores computed on the
-MXU in fp32 — plus Pallas backward kernels (dq and dk/dv) that recompute
-scores per tile from the saved logsumexp, so the backward is O(S) memory too
-(the standard flash-attention-2 recomputation scheme).
+MXU in fp32 — plus Pallas backward kernels that recompute scores per tile
+from the saved logsumexp, so the backward is O(S) memory too (the
+flash-attention-2 recomputation scheme; the resident family fuses dq and
+dk/dv into one kernel, the streaming family keeps them split).
 
 GQA: the kernels map query head ``h`` to KV head ``h // (H // K)`` in the
 BlockSpec index map — KV are never repeated in memory (the reference's
@@ -88,9 +89,11 @@ LONG_STREAM_THRESHOLD = 32768
 STREAM_FWD_BLOCK_Q, STREAM_FWD_BLOCK_K = 1024, 512
 STREAM_DQ_BLOCK_Q, STREAM_DQ_BLOCK_K = 512, 1024
 STREAM_DKV_BLOCK_Q, STREAM_DKV_BLOCK_K = 1024, 512
-# Above this sequence length the resident kernels' full-row VMEM operands no
-# longer fit (empirically the dk/dv kernel is first to die: 18.4M scoped vmem
-# vs the 16M limit at S=4096, D=64); switch to the streaming kernels.
+# Above this sequence length the resident kernels' full-row VMEM operands
+# no longer fit the 16M scoped-vmem limit at D=64 (originally measured on
+# the split dk/dv kernel at S=4096; the fused backward holds even more —
+# full-row K/V plus two (S, D) fp32 dk/dv scratch rows); switch to the
+# streaming kernels.
 STREAM_THRESHOLD = 2048
 NEG_INF = -1e30
 LOG2E = math.log2(math.e)
@@ -600,8 +603,11 @@ def _flash_fwd(q, k, v, causal, interpret):
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
-    """Pallas backward: dq via (head, q-tile) grid, dk/dv via a
-    (kv-head, k-tile) grid that accumulates the GQA group in-kernel."""
+    """Pallas backward. Resident family: ONE fused kernel on a
+    (b, h, q-tile) grid producing dq, dk and dv per pass
+    (_bwd_fused_kernel). Streaming family: split kernels — dq via a
+    (head, q-tile, k-step) grid, dk/dv via a (kv-head, k-tile, q-step)
+    grid that accumulates the GQA group in-kernel."""
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
@@ -640,10 +646,6 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
                             pltpu.VMEM((s, d), jnp.float32)],
             interpret=interpret,
         )(qt, kt, vt, dot, lse, ot)
-        dq_out = jnp.transpose(dq, (0, 2, 1, 3))
-        dk_out = jnp.transpose(dk, (0, 2, 1, 3))
-        dv_out = jnp.transpose(dv, (0, 2, 1, 3))
-        return dq_out, dk_out, dv_out
     else:
         q_spec = pl.BlockSpec((1, 1, dq_bq, d),
                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
@@ -675,44 +677,44 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
             interpret=interpret,
         )(qt, kt, vt, dot, lse, ot)
 
-    # Grid over KV heads: block index maps pick up this head's group of G
-    # query heads ((1, G, ...) blocks); dk/dv land at KV-head granularity —
-    # no (B, H, S, D) expansion buffer. (Streaming only: the resident
-    # family returned above with dk/dv from the fused kernel.)
-    kv_spec = pl.BlockSpec((1, 1, dkv_bk, d),
-                           lambda bi, hi, ki, qi: (bi, hi, ki, 0))
-    if causal:  # steps before the diagonal are no-ops: pin their q fetch
-        def dkv_q_idx(bi, hi, ki, qi):
-            return (bi, hi, jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
+        # Grid over KV heads: block index maps pick up this head's group
+        # of G query heads ((1, G, ...) blocks); dk/dv land at KV-head
+        # granularity — no (B, H, S, D) expansion buffer. (Streaming
+        # only: the resident family's fused kernel produced dk/dv above.)
+        kv_spec = pl.BlockSpec((1, 1, dkv_bk, d),
+                               lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+        if causal:  # steps before the diagonal are no-ops: pin their q fetch
+            def dkv_q_idx(bi, hi, ki, qi):
+                return (bi, hi, jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
 
-        def dkv_row_idx(bi, hi, ki, qi):
-            return (bi, hi, 0, jnp.maximum(qi, ki * dkv_bk // dkv_bq))
-    else:
-        def dkv_q_idx(bi, hi, ki, qi):
-            return (bi, hi, qi, 0)
+            def dkv_row_idx(bi, hi, ki, qi):
+                return (bi, hi, 0, jnp.maximum(qi, ki * dkv_bk // dkv_bq))
+        else:
+            def dkv_q_idx(bi, hi, ki, qi):
+                return (bi, hi, qi, 0)
 
-        def dkv_row_idx(bi, hi, ki, qi):
-            return (bi, hi, 0, qi)
-    qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
-    rowgrp_spec = (
-        pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
-        else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
-                          block_k=dkv_bk, scale=scale, causal=causal,
-                          packed=packed),
-        grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
-        in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                  qgrp_spec],
-        out_specs=[kv_spec, kv_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct(kt.shape, k.dtype),
-            jax.ShapeDtypeStruct(vt.shape, v.dtype),
-        ],
-        scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
-                        pltpu.VMEM((dkv_bk, d), jnp.float32)],
-        interpret=interpret,
-    )(qt, kt, vt, dot, lse, ot)
+            def dkv_row_idx(bi, hi, ki, qi):
+                return (bi, hi, 0, qi)
+        qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
+        rowgrp_spec = (
+            pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
+            else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
+                              block_k=dkv_bk, scale=scale, causal=causal,
+                              packed=packed),
+            grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
+            in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
+                      qgrp_spec],
+            out_specs=[kv_spec, kv_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
+                            pltpu.VMEM((dkv_bk, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, ot)
     dq_out = jnp.transpose(dq, (0, 2, 1, 3))
     dk_out = jnp.transpose(dk, (0, 2, 1, 3))
     dv_out = jnp.transpose(dv, (0, 2, 1, 3))

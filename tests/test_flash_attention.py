@@ -50,6 +50,15 @@ def test_flash_gradients_match_d64():
     _check_gradients(512, 4, 2, 64)
 
 
+@pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (512, 2, 2, 64)])
+def test_resident_fused_backward_non_causal(s, h, kv, d):
+    """The fused resident backward's non-causal branch (full k-loop
+    bounds, no masked tail) — every other resident-family case runs
+    causal=True, and the non-causal streaming tests force streaming on,
+    so this branch is otherwise uncovered."""
+    _check_gradients(s, h, kv, d, causal=False)
+
+
 @pytest.mark.parametrize("long_tiles", [False, True])
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32),
@@ -89,15 +98,16 @@ def test_streaming_kernels_match(s, h, kv, d, causal, long_tiles,
                                    rtol=5e-4, atol=5e-5)
 
 
-def _check_gradients(s, h, kv, d):
+def _check_gradients(s, h, kv, d, causal=True):
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.standard_normal((1, s, h, d)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
 
-    g_ref = jax.grad(lambda *a: jnp.sum(xla_attention(*a, causal=True) ** 2),
-                     argnums=(0, 1, 2))(q, k, v)
-    g_flash = jax.grad(lambda *a: jnp.sum(flash_attention(*a, True) ** 2),
+    g_ref = jax.grad(
+        lambda *a: jnp.sum(xla_attention(*a, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal) ** 2),
                        argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ref, g_flash):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
