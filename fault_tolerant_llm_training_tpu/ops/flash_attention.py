@@ -47,16 +47,19 @@ boundary.
 
 Two kernel families, dispatched on sequence length:
 
-- **Resident** (S <= STREAM_THRESHOLD): the non-grid operand (K/V, and
-  the dk/dv gradient accumulators) sits whole in VMEM and an in-kernel
-  fori_loop walks it. Fastest at moderate S — no per-block pipeline
-  boundaries — but VMEM-bound: the resident rows grow linearly with S.
-  The backward is ONE fused kernel (_bwd_fused_kernel) producing dq, dk
-  and dv from a single pass over the causal tile triangle — the split
-  FA2 scheme recomputes the VPU-bound softmax core (scores, exp2,
-  dO @ V^T, dS) twice per tile, once in dq and once in dk/dv; fusing it
-  measured +10.9% on the headline bench (98.2k -> 109.0k tokens/s) and
-  +9.4% at bs 16 (BASELINE.md round 3).
+- **Resident** (forward: S <= STREAM_THRESHOLD; backward: S*D within
+  RESIDENT_BWD_SD_BUDGET, which reaches past the forward's cutover): the
+  non-grid operand (K/V, and the dk/dv gradient accumulators) sits whole
+  in VMEM and an in-kernel fori_loop walks it. Fastest at moderate S —
+  no per-block pipeline boundaries — but VMEM-bound: the resident rows
+  grow linearly with S*D. The backward is ONE fused kernel
+  (_bwd_fused_kernel) producing dq, dk and dv from a single pass over
+  the causal tile triangle — the split FA2 scheme recomputes the
+  VPU-bound softmax core (scores, exp2, dO @ V^T, dS) twice per tile,
+  once in dq and once in dk/dv; fusing it measured +10.9% on the
+  headline bench (98.2k -> 109.0k tokens/s), +9.4% at bs 16, and −9.6%
+  fwd+bwd at S=4096 where it outlives the streamed forward
+  (BASELINE.md round 3).
 - **Streaming** (S > STREAM_THRESHOLD): the loop moves into the grid's
   innermost dimension; the online-softmax / gradient accumulators live in
   VMEM scratch that persists across grid steps, and every operand is a
@@ -89,12 +92,24 @@ LONG_STREAM_THRESHOLD = 32768
 STREAM_FWD_BLOCK_Q, STREAM_FWD_BLOCK_K = 1024, 512
 STREAM_DQ_BLOCK_Q, STREAM_DQ_BLOCK_K = 512, 1024
 STREAM_DKV_BLOCK_Q, STREAM_DKV_BLOCK_K = 1024, 512
-# Above this sequence length the resident kernels' full-row VMEM operands
-# no longer fit the 16M scoped-vmem limit at D=64 (originally measured on
-# the split dk/dv kernel at S=4096; the fused backward holds even more —
-# full-row K/V plus two (S, D) fp32 dk/dv scratch rows); switch to the
-# streaming kernels.
+# Above this sequence length the resident FORWARD kernel's full-row VMEM
+# operands no longer fit the 16M scoped-vmem limit at D=64 (originally
+# measured on the split dk/dv kernel at S=4096); switch to the streaming
+# kernels.
 STREAM_THRESHOLD = 2048
+# The fused backward stays viable past the forward's threshold — its
+# residency is K/V rows + two (S, D) fp32 dk/dv scratch rows + the
+# double-buffered q-side tiles, all linear in S*D: calibrated at D=64,
+# S=8192 measured 21.0M > the 16M scoped limit while S=4096 fits, so the
+# dispatch bound is S*D <= 4096*64 (a D=128 model hits the same wall at
+# half the S). Within the bound but past STREAM_THRESHOLD, the forward
+# streams while the backward runs fused (one softmax-core pass instead
+# of two).
+RESIDENT_BWD_SD_BUDGET = 4096 * 64
+
+
+def _fused_bwd_fits(s: int, d: int) -> bool:
+    return s * d <= RESIDENT_BWD_SD_BUDGET
 NEG_INF = -1e30
 LOG2E = math.log2(math.e)
 LN2 = math.log(2.0)
@@ -172,14 +187,18 @@ def _lse_layout(s: int) -> bool:
     """Whether to carry lse packed as (B, H, 1, S) instead of the legacy
     (B, H, S, 1) whose singleton lane the TPU tile pads 128x.
 
-    Packed only for the STREAMING family (long context), where the
-    padding is the point — e.g. 384 MB of padding at S=64k — and only
+    Packed only when the FORWARD streams (s > STREAM_THRESHOLD), where
+    the padding is the point — e.g. 384 MB of padding at S=64k — and only
     when every q-tile is 128-lane aligned (odd sequence lengths degrade
-    tiles below 128 rows, making the packed blocks illegal). The resident
-    family keeps the legacy layout: packing it was measured 3% slower on
-    the S=2048 headline bench (the per-tile (1, bq) -> (bq, 1) relayouts
-    in the backward hot loops cost more than the ~1 GB of padding they
-    save), while at bs 16 the padding made no wall-clock difference."""
+    tiles below 128 rows, making the packed blocks illegal). Consumers
+    (all via _read_lse): the streaming backward kernels, and the FUSED
+    resident backward when it runs past the forward's threshold (see
+    RESIDENT_BWD_SD_BUDGET — one entry transpose per grid step). At
+    s <= STREAM_THRESHOLD everything stays legacy: packing the resident
+    emit was measured and rejected twice (round 2 naive: −3%; round 3,
+    four variants incl. fully transposed tile math: −1.4 to −2.7% — the
+    transposed contraction forms cost more than the ~1 GB of padding
+    buys, which is nothing at either batch size; BASELINE.md)."""
     return (s > STREAM_THRESHOLD
             and all(_fit_block(s, bq) % 128 == 0
                     for bq, _ in _active_tiles(s)))
@@ -285,7 +304,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                       dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                      block_k: int, scale: float, causal: bool, group: int):
+                      block_k: int, scale: float, causal: bool, group: int,
+                      packed: bool):
     """Fused resident backward: dq, dk and dv from ONE pass over the score
     tiles.
 
@@ -315,7 +335,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
 
     q2 = _prescale_q(q_ref[0, 0], scale)
     do = do_ref[0, 0]
-    lse = lse_ref[0, 0]
+    # lse is read once per grid step, so the packed (1, block_q) row (used
+    # above STREAM_THRESHOLD, where the forward streamed and emitted the
+    # packed layout) affords a single entry transpose.
+    lse = _read_lse(lse_ref, 0, packed)
     delta = _delta(do, o_ref[0, 0])
     block_q, d = q2.shape
     s_k = k_ref.shape[2]
@@ -624,16 +647,22 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     # delta (rowwise dO . O) is computed inside the kernels from the do/o
     # tiles (see _delta) — no fp32 materialization at the XLA level.
 
-    if s <= STREAM_THRESHOLD:
+    if _fused_bwd_fits(s, d):
         # Fused single-pass backward (see _bwd_fused_kernel): dq, dk, dv
-        # from one walk of the causal tile triangle.
+        # from one walk of the causal tile triangle. Runs past the
+        # forward's STREAM_THRESHOLD (see RESIDENT_BWD_SD_BUDGET) — there
+        # the forward emitted the packed lse layout.
         q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
         kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
-        row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
-                                lambda bi, hi, qi: (bi, hi, qi, 0))
+        if packed:
+            row_spec = pl.BlockSpec((1, 1, 1, dq_bq),
+                                    lambda bi, hi, qi: (bi, hi, 0, qi))
+        else:
+            row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
+                                    lambda bi, hi, qi: (bi, hi, qi, 0))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, block_k=dq_bk, scale=scale,
-                              causal=causal, group=group),
+                              causal=causal, group=group, packed=packed),
             grid=(b, h, s // dq_bq),
             in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, q_spec],
             out_specs=[pl.BlockSpec((1, 1, dq_bq, d),

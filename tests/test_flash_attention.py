@@ -59,6 +59,19 @@ def test_resident_fused_backward_non_causal(s, h, kv, d):
     _check_gradients(s, h, kv, d, causal=False)
 
 
+@pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (1024, 2, 2, 64)])
+def test_fused_backward_with_streamed_forward(s, h, kv, d, monkeypatch):
+    """When the forward streams but S*D is within RESIDENT_BWD_SD_BUDGET,
+    the forward emits the PACKED lse layout and the backward runs the
+    fused kernel — its packed entry-transpose path. Forced on at small S
+    by lowering only the forward threshold."""
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+    monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
+    assert fa._lse_layout(s)  # the combination under test needs packed
+    assert fa._fused_bwd_fits(s, d)
+    _check_gradients(s, h, kv, d, batch=2, seed=2)
+
+
 @pytest.mark.parametrize("long_tiles", [False, True])
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("s,h,kv,d", [(512, 4, 2, 32), (2048, 2, 1, 32),
@@ -79,6 +92,11 @@ def test_streaming_kernels_match(s, h, kv, d, causal, long_tiles,
     default tiles never produce."""
     import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
     monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
+    # force the SPLIT streaming backward too: with only the forward
+    # threshold lowered, the fused backward (viable within
+    # RESIDENT_BWD_SD_BUDGET) would take over and the streaming dq/dkv
+    # kernels would lose their coverage
+    monkeypatch.setattr(fa, "RESIDENT_BWD_SD_BUDGET", 0)
     if long_tiles:
         monkeypatch.setattr(fa, "LONG_STREAM_THRESHOLD", 0)
     rng = np.random.default_rng(0)
@@ -98,11 +116,11 @@ def test_streaming_kernels_match(s, h, kv, d, causal, long_tiles,
                                    rtol=5e-4, atol=5e-5)
 
 
-def _check_gradients(s, h, kv, d, causal=True):
-    rng = np.random.default_rng(1)
-    q = jnp.asarray(rng.standard_normal((1, s, h, d)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((1, s, kv, d)), jnp.float32)
+def _check_gradients(s, h, kv, d, causal=True, batch=1, seed=1):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((batch, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((batch, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((batch, s, kv, d)), jnp.float32)
 
     g_ref = jax.grad(
         lambda *a: jnp.sum(xla_attention(*a, causal=causal) ** 2),
