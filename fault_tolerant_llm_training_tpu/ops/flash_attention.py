@@ -78,8 +78,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Tile sizes tuned on TPU v5e at S=2048, D=64 (see BASELINE.md); each kernel
 # has its own operating point because the blocks play different roles: the
-# q-tile is the grid unit in fwd/dq but the loop chunk in dkv, and vice versa.
-FWD_BLOCK_Q, FWD_BLOCK_K = 1024, 256
+# q-tile is the grid unit in fwd/dq but the loop chunk in dkv, and vice
+# versa. FWD retuned in round 3 after the backward fusion shifted the
+# balance (512x1024: within 1% of the bs-8 peak and best at bs 16; the
+# bs-8 peak 256x1024 collapses 26x at bs 16 — BASELINE.md).
+FWD_BLOCK_Q, FWD_BLOCK_K = 512, 1024
 DQ_BLOCK_Q, DQ_BLOCK_K = 512, 512
 DKV_BLOCK_Q, DKV_BLOCK_K = 512, 1024
 # Very long sequences get their own operating point (tuned at S=32k/64k,

@@ -47,12 +47,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import (
-    DKV_BLOCK_K,
-    DKV_BLOCK_Q,
-    DQ_BLOCK_K,
-    DQ_BLOCK_Q,
-    FWD_BLOCK_K,
-    FWD_BLOCK_Q,
     NEG_INF,
     _delta,
     _dkv_tile,
@@ -61,6 +55,16 @@ from .flash_attention import (
     _online_softmax_step,
     _prescale_q,
 )
+
+# Ring carry tiles, pinned to the values the S=64k on-chip measurements
+# were taken with (BASELINE.md round 2). Deliberately NOT shared with
+# flash_attention's constants: those get retuned for the single-chip
+# resident regime (round 3 moved FWD to 512x1024 for the fused-backward
+# balance), and a silent inheritance would change the ring kernels'
+# operating point in a long-sequence regime no such sweep covered.
+RING_FWD_BLOCK_Q, RING_FWD_BLOCK_K = 1024, 256
+RING_DQ_BLOCK_Q, RING_DQ_BLOCK_K = 512, 512
+RING_DKV_BLOCK_Q, RING_DKV_BLOCK_K = 512, 1024
 
 __all__ = [
     "carry_fwd",
@@ -226,7 +230,7 @@ def carry_fwd(q, k, v, m, l, acc, q_off, k_off, *, causal=True,
     kv = k.shape[1]
     group = h // kv
     s_k = k.shape[2]
-    bq, bk = _fit_block(s_q, FWD_BLOCK_Q), _fit_block(s_k, FWD_BLOCK_K)
+    bq, bk = _fit_block(s_q, RING_FWD_BLOCK_Q), _fit_block(s_k, RING_FWD_BLOCK_K)
     scale = 1.0 / (d ** 0.5)
     grid = (b, h, s_q // bq, s_k // bk)
 
@@ -263,7 +267,7 @@ def carry_dq(q, k, v, do, lse, delta, dq, q_off, k_off, *, causal=True,
     kv = k.shape[1]
     group = h // kv
     s_k = k.shape[2]
-    bq, bk = _fit_block(s_q, DQ_BLOCK_Q), _fit_block(s_k, DQ_BLOCK_K)
+    bq, bk = _fit_block(s_q, RING_DQ_BLOCK_Q), _fit_block(s_k, RING_DQ_BLOCK_K)
     scale = 1.0 / (d ** 0.5)
     grid = (b, h, s_q // bq, s_k // bk)
 
@@ -300,7 +304,7 @@ def carry_dkv(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *, causal=True,
     kv = k.shape[1]
     group = h // kv
     s_k = k.shape[2]
-    bq, bk = _fit_block(s_q, DKV_BLOCK_Q), _fit_block(s_k, DKV_BLOCK_K)
+    bq, bk = _fit_block(s_q, RING_DKV_BLOCK_Q), _fit_block(s_k, RING_DKV_BLOCK_K)
     scale = 1.0 / (d ** 0.5)
     grid = (b, kv, s_k // bk, s_q // bq)
 

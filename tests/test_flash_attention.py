@@ -12,17 +12,18 @@ from fault_tolerant_llm_training_tpu.ops.flash_attention import flash_attention
 @pytest.mark.parametrize("s,h,kv,d", [
     (256, 4, 4, 32),
     (512, 4, 2, 32),
-    # Full tuned operating point: exercises the fwd bq=1024 tail split and
-    # the dkv straddle logic with block_k=1024 > block_q=512 (multiple
-    # masked q-blocks per k-tile) — shapes smaller than the tuned blocks
-    # clamp them away and never hit these paths.
+    # Full tuned operating point: exercises the fwd block_k=1024 >
+    # block_q=512 straddle (multiple masked k-phases per q-tile) and the
+    # dkv straddle with block_k=1024 > block_q=512 (multiple masked
+    # q-blocks per k-tile) — shapes smaller than the tuned blocks clamp
+    # them away and never hit these paths.
     (2048, 2, 1, 32),
     # d=64 is the PRODUCTION head dim (gpt2-125m and the tuned tile
     # tables) — round 1 tested d=32 only (VERDICT weak spot #6).
     (512, 2, 2, 64),
     (512, 4, 2, 64),   # GQA at d=64
-    # Non-divisible S: 1536 degrades the tuned 1024-row fwd tile to 768
-    # via _fit_block; 328 = 8 * 41 forces the minimal 8-row tile.
+    # Non-divisible S: 1536 degrades the tuned 1024-lane fwd K-tile to
+    # 768 via _fit_block; 328 = 8 * 41 forces the minimal 8-row tile.
     (1536, 2, 1, 64),
     (328, 2, 2, 64),
 ])
