@@ -199,6 +199,20 @@ def test_injected_error_saves_no_resubmit_then_bitexact_resume(tmp_path, parquet
         assert base_losses[step] == loss, (step, base_losses[step], loss)
 
 
+def test_checkpoint_budget_warns_when_lead_too_short(tmp_path, parquet):
+    """--signal-lead-seconds 0 makes ANY estimated save exceed the lead:
+    the startup budget check (checkpoint/manager.py, SURVEY §7.3 #2) must
+    WARN — the branch that fires on a real cluster when the flagship save
+    cannot fit the scheduler's USR1 window — and training still proceeds
+    (the warning informs; it must not block)."""
+    argv = _args(tmp_path, parquet,
+                 **{"--signal-lead-seconds": "0", "--training-steps": "5"})
+    rc, out = _run(argv, job_id="bw1")
+    assert rc == 0, out
+    assert "Checkpoint budget EXCEEDED" in out
+    assert "Training completed" in out
+
+
 def test_resume_on_different_topology(tmp_path, parquet):
     """SURVEY.md §7.3 hard part 3: a checkpoint written on one topology must
     resume on another with the same loss trajectory. Here: save on a single

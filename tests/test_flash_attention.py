@@ -12,20 +12,25 @@ from fault_tolerant_llm_training_tpu.ops.flash_attention import flash_attention
 @pytest.mark.parametrize("s,h,kv,d", [
     (256, 4, 4, 32),
     (512, 4, 2, 32),
-    # Full tuned operating point: exercises the fwd block_k=1024 >
-    # block_q=512 straddle (multiple masked k-phases per q-tile) and the
-    # dkv straddle with block_k=1024 > block_q=512 (multiple masked
-    # q-blocks per k-tile) — shapes smaller than the tuned blocks clamp
-    # them away and never hit these paths.
+    # Full tuned operating point: the fwd (512, 1024) geometry (bk > bq:
+    # exactly one masked k-phase per q-tile, n_total - n_full == 1) and
+    # the fused backward at the full 512x512 tiles — shapes smaller than
+    # the tuned blocks clamp them away and never hit these paths. (The
+    # split STREAMING kernels' straddles are covered separately by
+    # test_streaming_kernels_match, which forces them on.)
     (2048, 2, 1, 32),
     # d=64 is the PRODUCTION head dim (gpt2-125m and the tuned tile
     # tables) — round 1 tested d=32 only (VERDICT weak spot #6).
     (512, 2, 2, 64),
     (512, 4, 2, 64),   # GQA at d=64
     # Non-divisible S: 1536 degrades the tuned 1024-lane fwd K-tile to
-    # 768 via _fit_block; 328 = 8 * 41 forces the minimal 8-row tile.
+    # 768 via _fit_block; 328 = 8 * 41 < every tuned block, so the whole
+    # sequence becomes one full tile (the min(block, s) fallback); 1048 =
+    # 8 * 131 has no divisor in [16, 1024] that is a multiple of 8, so
+    # _fit_block returns the MINIMAL 8-row tile for every kernel.
     (1536, 2, 1, 64),
     (328, 2, 2, 64),
+    (1048, 2, 2, 64),
 ])
 def test_flash_matches_reference(s, h, kv, d):
     rng = np.random.default_rng(0)
