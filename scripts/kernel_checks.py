@@ -5,8 +5,10 @@ tests/test_ring_attention.py) with checks where the kernels actually run
 compiled, at the tuned production tiles (VERDICT round-1 weak spot #6: the
 tuned D=64 shapes had no on-chip parity pin):
 
-1. flash-vs-XLA allclose at the production shapes (D=64; resident S=2048
-   and streaming S=4096), forward AND gradients.
+1. flash-vs-XLA allclose at the production shapes (D=64), forward AND
+   gradients: resident S=2048; S=4096 (streamed forward + FUSED backward
+   within the S*D budget, GQA); S=16384 (streamed forward + the SPLIT
+   streaming backward, the only dispatch above the budget).
 2. A single-chip S=64k ring-carry check: the last ring position's work —
    its query block folded against all sp KV blocks through the carry
    kernels (ops/ring_flash.py) exactly as the per-device ring loop does —
@@ -153,7 +155,8 @@ def check_ring_carry_64k(s=65536, sp=8, h=4, kv=2, d=64):
 def main():
     ok = True
     ok &= check_flash_parity(2048, 12, 12, 64)   # resident, bench shape
-    ok &= check_flash_parity(4096, 4, 2, 64)     # streaming + GQA
+    ok &= check_flash_parity(4096, 4, 2, 64)     # streamed fwd + fused bwd, GQA
+    ok &= check_flash_parity(16384, 4, 2, 64)    # split streaming bwd, GQA
     ok &= check_ring_carry_64k()
     sys.exit(0 if ok else 1)
 
