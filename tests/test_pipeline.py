@@ -139,6 +139,22 @@ def test_pipeline_moe_matches_grad_accum(eight_devices):
     assert abs(pp0[0] - pp[0]) > 1e-6
 
 
+def test_pipeline_composes_with_grad_accum(eight_devices):
+    """--grad-accum slices the batch OUTSIDE the pipeline; each slice then
+    runs the full 1F1B schedule with its own microbatch split. Because
+    both mechanisms weight per-microbatch losses (and MoE aux) by valid
+    tokens, pp=2 x (grad_accum=2, microbatches=2) must reproduce the
+    single-device grad_accum=4 trajectory exactly — same 4 slices of the
+    batch in the same order."""
+    cfg = get_config("tiny-moe", moe_impl="capacity",
+                     moe_capacity_factor=8.0, **FP32)
+    base, _ = _run_train(cfg, dict(dp=1, devices=[jax.devices()[0]]),
+                         grad_accum=4)
+    pp, _ = _run_train(cfg, dict(dp=1, pp=2, fsdp=2), microbatches=2,
+                       grad_accum=2)
+    np.testing.assert_allclose(base, pp, rtol=5e-5, atol=1e-6)
+
+
 def test_pipeline_blocked_vocab_tail(eight_devices):
     """At a vocab slice > the CE block size the in-loop head takes the
     blocked online-softmax path (shared with ops/fused_ce.py); trajectory
