@@ -20,6 +20,7 @@ from fault_tolerant_llm_training_tpu.parallel.sharding import (
 )
 from fault_tolerant_llm_training_tpu.training.state import TrainState
 from fault_tolerant_llm_training_tpu.training.step import (
+    make_eval_step,
     make_optimizer,
     make_train_step,
 )
@@ -28,6 +29,12 @@ from test_fault_tolerance import parquet  # noqa: F401  (shared fixture)
 
 FP32 = dict(dtype=jnp.float32, param_dtype=jnp.float32, attention_impl="xla",
             layer_impl="scan")
+
+
+def _labels(toks):
+    """Next-token labels with the -100 ignore tail (ref dataset.py:44-53)."""
+    return np.concatenate(
+        [toks[:, 1:], np.full((toks.shape[0], 1), -100, np.int32)], axis=1)
 
 
 def _setup(seed=0, batch=4):
@@ -102,8 +109,7 @@ def _run_train(cfg, mesh_kwargs, microbatches=0, grad_accum=1, n_steps=3,
         for _ in range(n_steps):
             toks = rng.integers(0, cfg.vocab_size, (batch, 32)).astype(
                 np.int32)
-            labels = np.concatenate(
-                [toks[:, 1:], np.full((batch, 1), -100, np.int32)], axis=1)
+            labels = _labels(toks)
             state, metrics = step_fn(state, jax.device_put(toks, bsh),
                                      jax.device_put(labels, bsh))
             losses.append(float(metrics["loss"]))
@@ -153,6 +159,31 @@ def test_pipeline_composes_with_grad_accum(eight_devices):
     pp, _ = _run_train(cfg, dict(dp=1, pp=2, fsdp=2), microbatches=2,
                        grad_accum=2)
     np.testing.assert_allclose(base, pp, rtol=5e-5, atol=1e-6)
+
+
+def test_pipeline_moe_eval_reports_pure_ce(eight_devices):
+    """Eval of an MoE model on a pipeline mesh (previously hard-blocked):
+    the GPipe forward path drops the routers' sown aux — which is exactly
+    right for eval, whose contract is pure CE (training/step.py). The
+    packed (sum_nll, n) must equal the single-device eval of the same
+    params."""
+    cfg = get_config("tiny-moe", moe_capacity_factor=8.0, **FP32)
+    model = Transformer(cfg)
+    rng = np.random.default_rng(31)
+    toks = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    labels = _labels(toks)
+    params = model.init(jax.random.PRNGKey(2), jnp.asarray(toks))["params"]
+
+    with use_mesh(make_mesh(dp=1, devices=[jax.devices()[0]])):
+        want = jax.jit(make_eval_step(model))(
+            params, jnp.asarray(toks), jnp.asarray(labels))
+    mesh = make_mesh(dp=1, pp=2, fsdp=2)
+    with use_mesh(mesh):
+        bsh = NamedSharding(mesh, batch_pspec())
+        got = jax.jit(make_eval_step(model, microbatches=4))(
+            params, jax.device_put(toks, bsh), jax.device_put(labels, bsh))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-5, atol=1e-6)
 
 
 def test_pipeline_blocked_vocab_tail(eight_devices):
@@ -235,8 +266,7 @@ def test_pipeline_head_not_replicated(eight_devices):
     cfg, model, params, tokens = _setup(batch=4)
     v = cfg.vocab_size
     mesh = make_mesh(dp=1, pp=2)
-    labels = np.concatenate(
-        [tokens[:, 1:], np.full((4, 1), -100, np.int32)], axis=1)
+    labels = _labels(tokens)
     with use_mesh(mesh):
         fn = jax.jit(jax.grad(
             lambda p, t, l: model_loss(model, p, t, l)[0]))
