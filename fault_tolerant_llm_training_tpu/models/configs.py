@@ -49,6 +49,44 @@ class TransformerConfig:
     # fusion (98.3k -> 80.0k tokens/s on the headline bench). Both compute
     # identical functions; models/llama.py has the param-layout converters.
     layer_impl: str = "loop"
+    # Merge the three attention projections into ONE matmul against the
+    # concatenated (D, (H+2K)*dh) kernel (params stay the separate
+    # wq/wk/wv trees — the concat is a per-step weight-side reshape that
+    # XLA folds). Measured REJECTION on v5e (BASELINE.md round 4:
+    # 110.3k vs 113.8k base, and still -2% on top of the other round-4
+    # wins) — kept as an option for other generations.
+    fused_qkv: bool = False
+    # SwiGLU gate+up in one (D, 2*hidden) matmul, split after. Default ON:
+    # +2.2% on the headline bench stacked on the in-kernel rope
+    # (BASELINE.md round 4); parity with separate matmuls is reduction-
+    # order-only (tested).
+    fused_w13: bool = True
+    # Where RoPE is computed: "xla" = elementwise fp32 rope on (B,S,H,D)
+    # activations (ops/rope.py apply_rope — reference-parity math);
+    # "fused" = inside the Pallas flash kernels via the J-matrix rotation
+    # (ops/flash_attention.py flash_attention_rope) — no rotated q/k or
+    # fp32 rope intermediate ever materializes at the XLA level, which
+    # removes the rope-adjacent relayout-copy family at the custom-call
+    # boundary. "fused" engages only on the single-chip pallas path with
+    # prefix positions; other paths (ring/xla/per-token positions) fall
+    # back to "xla" automatically. Default "fused": +3.7% headline and the
+    # fp32 relayout-copy family at the custom-call boundary disappears
+    # from the profile (BASELINE.md round 4); parity with the xla path is
+    # pinned to fp32 noise in tests/test_flash_attention.py.
+    rope_impl: str = "fused"
+    # Layout of the rope+flash-attention chain: "bshd" reshapes to
+    # (B, S, H, D), applies rope, and lets the kernel wrapper transpose to
+    # the (B, H, S, D) the TPU tiles need — XLA inserts fp32 layout copies
+    # at the custom-call boundary (the 11.5 ms/step "copy" family in the
+    # BASELINE.md profile). "bhsd" transposes FIRST and applies rope in
+    # the kernel-native layout so the rope fusion emits exactly what the
+    # custom call consumes. Only the single-chip pallas path honors
+    # "bhsd"; ring/xla paths keep bshd — and rope_impl="fused" (the
+    # default) SUPERSEDES it entirely: the fused-rope branch feeds the
+    # kernel raw head-major operands itself, so "bhsd" only changes
+    # anything under rope_impl="xla" (measured +0.5% there, round 4 —
+    # kept as the layout experiment knob for the non-fused path).
+    qkv_layout: str = "bshd"
     # Pipeline-parallel schedule (parallel/pipeline.py; only read when the
     # mesh's pipe axis is >1): "1f1b" interleaves each microbatch's
     # backward as soon as its loss gradient exists — activation memory
@@ -78,6 +116,8 @@ class TransformerConfig:
         for field, allowed in (("layer_impl", ("loop", "scan")),
                                ("pp_schedule", ("1f1b", "gpipe")),
                                ("sp_layout", ("zigzag", "contiguous")),
+                               ("qkv_layout", ("bshd", "bhsd")),
+                               ("rope_impl", ("xla", "fused")),
                                ("attention_impl",
                                 ("auto", "xla", "pallas", "ring")),
                                ("embed_impl", ("auto", "gather", "one_hot")),

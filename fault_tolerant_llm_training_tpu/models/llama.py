@@ -25,7 +25,12 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.attention import multihead_attention
-from ..ops.rope import apply_rope, precompute_rope, rope_cos_sin
+from ..ops.rope import (
+    apply_rope,
+    apply_rope_bhsd,
+    precompute_rope,
+    rope_cos_sin,
+)
 from ..parallel.mesh import mesh_axis_size
 from ..parallel.sharding import constrain
 from .configs import TransformerConfig
@@ -107,6 +112,23 @@ class TokenEmbed(nn.Module):
         return constrain(out, "batch", "seq", "act_embed")
 
 
+class _Kernel(nn.Module):
+    """Declares a Dense-compatible kernel param (``<name>/kernel``) and
+    returns it raw — the fused projection paths (``cfg.fused_qkv`` /
+    ``cfg.fused_w13``) contract several projections' kernels in ONE
+    matmul while keeping the param tree byte-identical to the separate
+    ``nn.Dense`` modules (checkpoints, shardings and the torch converter
+    see no difference; init RNG folds over the same module path, so
+    initial values match too)."""
+
+    shape: tuple
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", _DENSE_INIT, self.shape, self.param_dtype)
+
+
 class Attention(nn.Module):
     """GQA causal self-attention (ref: model.py:129-215)."""
 
@@ -116,36 +138,84 @@ class Attention(nn.Module):
     def __call__(self, x, positions=None):
         cfg = self.cfg
         dh = cfg.head_dim
+        nq, nkv = cfg.n_heads * dh, cfg.kv_heads * dh
         dense = dict(use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=_DENSE_INIT)
-        q = nn.Dense(cfg.n_heads * dh, name="wq", **dense)(x)
-        k = nn.Dense(cfg.kv_heads * dh, name="wk", **dense)(x)
-        v = nn.Dense(cfg.kv_heads * dh, name="wv", **dense)(x)
+        if cfg.fused_qkv:
+            # One (D, (H+2K)*dh) matmul over the concatenated kernels:
+            # x is read once instead of three times, and the backward's
+            # dx / dW each collapse to one dot (autodiff of the concat is
+            # a slice). Weight-side concat cost: ~3 MB/layer, negligible.
+            wq = _Kernel((cfg.dim, nq), cfg.param_dtype, name="wq")()
+            wk = _Kernel((cfg.dim, nkv), cfg.param_dtype, name="wk")()
+            wv = _Kernel((cfg.dim, nkv), cfg.param_dtype, name="wv")()
+            qkv = x @ jnp.concatenate([wq, wk, wv], axis=1).astype(cfg.dtype)
+            q, k, v = (qkv[..., :nq], qkv[..., nq:nq + nkv],
+                       qkv[..., nq + nkv:])
+        else:
+            q = nn.Dense(nq, name="wq", **dense)(x)
+            k = nn.Dense(nkv, name="wk", **dense)(x)
+            v = nn.Dense(nkv, name="wv", **dense)(x)
         b, s = x.shape[0], x.shape[1]
         q = q.reshape(b, s, cfg.n_heads, dh)
         k = k.reshape(b, s, cfg.kv_heads, dh)
         v = v.reshape(b, s, cfg.kv_heads, dh)
 
-        # With sequence parallelism each shard holds a non-prefix slice of
-        # the sequence; cos/sin come from a positions x freqs outer product
-        # (sharded with the activations) rather than a table gather, which
-        # the SPMD partitioner can only reshard by full rematerialization.
-        if positions is None:
-            cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
-        else:
-            cos, sin = rope_cos_sin(dh, cfg.rope_theta, positions)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-
         impl = cfg.attention_impl
-        if impl in ("auto", "ring") and mesh_axis_size("sequence") > 1:
-            from ..ops.ring_attention import ring_attention
-            out = ring_attention(q, k, v, axis_name="sequence",
-                                 zigzag=(cfg.sp_layout == "zigzag"))
+        ring = impl in ("auto", "ring") and mesh_axis_size("sequence") > 1
+        resolved = impl
+        if impl in ("auto", "ring"):
+            resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if (not ring and resolved == "pallas" and positions is None
+                and cfg.rope_impl == "fused"):
+            # RoPE inside the kernels (ops/flash_attention.py
+            # flash_attention_rope): raw head-major q/k/v plus the
+            # interleave-duplicated (S, D) tables. No rotated q/k or rope
+            # backward exists at the XLA level.
+            from ..ops.flash_attention import flash_attention_rope
+            cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
+            cos2 = jnp.repeat(cos[:s], 2, axis=-1)
+            sin2 = jnp.repeat(sin[:s], 2, axis=-1)
+            out = jnp.transpose(
+                flash_attention_rope(jnp.transpose(q, (0, 2, 1, 3)),
+                                     jnp.transpose(k, (0, 2, 1, 3)),
+                                     jnp.transpose(v, (0, 2, 1, 3)),
+                                     cos2, sin2, True),
+                (0, 2, 1, 3))
+        elif (not ring and resolved == "pallas" and positions is None
+                and cfg.qkv_layout == "bhsd"):
+            # Kernel-native layout path: transpose BEFORE rope so the rope
+            # fusion computes in (and emits) exactly the (B, H, S, D)
+            # layout the Pallas custom call consumes — the bshd path below
+            # pays fp32 relayout copies at the boundary instead (the
+            # 11.5 ms/step copy family in the BASELINE.md profile).
+            from ..ops.flash_attention import flash_attention_bhsd
+            cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
+            qt = apply_rope_bhsd(jnp.transpose(q, (0, 2, 1, 3)), cos, sin)
+            kt = apply_rope_bhsd(jnp.transpose(k, (0, 2, 1, 3)), cos, sin)
+            vt = jnp.transpose(v, (0, 2, 1, 3))
+            out = jnp.transpose(flash_attention_bhsd(qt, kt, vt, True),
+                                (0, 2, 1, 3))
         else:
-            if impl == "ring":  # ring requested but no sequence axis active
-                impl = "auto"
-            out = multihead_attention(q, k, v, impl=impl, causal=True)
+            # With sequence parallelism each shard holds a non-prefix
+            # slice of the sequence; cos/sin come from a positions x freqs
+            # outer product (sharded with the activations) rather than a
+            # table gather, which the SPMD partitioner can only reshard by
+            # full rematerialization.
+            if positions is None:
+                cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
+            else:
+                cos, sin = rope_cos_sin(dh, cfg.rope_theta, positions)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if ring:
+                from ..ops.ring_attention import ring_attention
+                out = ring_attention(q, k, v, axis_name="sequence",
+                                     zigzag=(cfg.sp_layout == "zigzag"))
+            else:
+                if impl == "ring":  # ring requested but no sequence axis
+                    impl = "auto"
+                out = multihead_attention(q, k, v, impl=impl, causal=True)
         out = out.reshape(b, s, cfg.n_heads * dh)
         return nn.Dense(cfg.dim, name="wo", **dense)(out)
 
@@ -161,8 +231,17 @@ class FeedForward(nn.Module):
         hidden = cfg.ffn_hidden_dim
         dense = dict(use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=_DENSE_INIT)
-        gate = nn.Dense(hidden, name="w1", **dense)(x)
-        up = nn.Dense(hidden, name="w3", **dense)(x)
+        if cfg.fused_w13:
+            # Gate and up in ONE (D, 2*hidden) matmul (see _Kernel): x is
+            # read once, and the backward's dx is one dot instead of two
+            # accumulated ones.
+            w1 = _Kernel((cfg.dim, hidden), cfg.param_dtype, name="w1")()
+            w3 = _Kernel((cfg.dim, hidden), cfg.param_dtype, name="w3")()
+            h13 = x @ jnp.concatenate([w1, w3], axis=1).astype(cfg.dtype)
+            gate, up = h13[..., :hidden], h13[..., hidden:]
+        else:
+            gate = nn.Dense(hidden, name="w1", **dense)(x)
+            up = nn.Dense(hidden, name="w3", **dense)(x)
         return nn.Dense(cfg.dim, name="w2", **dense)(jax.nn.silu(gate) * up)
 
 

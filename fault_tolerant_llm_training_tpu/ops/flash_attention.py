@@ -70,6 +70,7 @@ Two kernel families, dispatched on sequence length:
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -79,12 +80,19 @@ from jax.experimental.pallas import tpu as pltpu
 # Tile sizes tuned on TPU v5e at S=2048, D=64 (see BASELINE.md); each kernel
 # has its own operating point because the blocks play different roles: the
 # q-tile is the grid unit in fwd/dq but the loop chunk in dkv, and vice
-# versa. FWD retuned in round 3 after the backward fusion shifted the
-# balance (512x1024: within 1% of the bs-8 peak and best at bs 16; the
-# bs-8 peak 256x1024 collapses 26x at bs 16 — BASELINE.md).
-FWD_BLOCK_Q, FWD_BLOCK_K = 512, 1024
+# versa. FWD retuned again in round 4 after the in-kernel rope shifted the
+# balance (512x512: 122.1k vs 120.5k at the round-3 512x1024, and best at
+# bs 16 too; round-3's sweep history: the bs-8 peak 256x1024 collapses 26x
+# at bs 16 — BASELINE.md).
+FWD_BLOCK_Q, FWD_BLOCK_K = 512, 512
 DQ_BLOCK_Q, DQ_BLOCK_K = 512, 512
 DKV_BLOCK_Q, DKV_BLOCK_K = 512, 1024
+# The mid-range STREAMING regime (STREAM_THRESHOLD < S <
+# LONG_STREAM_THRESHOLD) keeps the round-3 forward tiles: its A/B there
+# (S=8192: -13%, S=16384: -10% vs the older 1024x256) was measured with
+# the 1024-wide k-tile, and the round-4 resident retune does not transfer
+# (the grid-streamed pipeline amortizes differently).
+MID_FWD_BLOCK_Q, MID_FWD_BLOCK_K = 512, 1024
 # Very long sequences get their own operating point (tuned at S=32k/64k,
 # B1/H12/D64: -6.6% at 32k, -14.5% at 64k vs the resident tiles — the
 # grid-streamed pipeline prefers larger k-tiles in fwd/dq and a larger
@@ -108,7 +116,16 @@ STREAM_THRESHOLD = 2048
 # half the S). Within the bound but past STREAM_THRESHOLD, the forward
 # streams while the backward runs fused (one softmax-core pass instead
 # of two).
-RESIDENT_BWD_SD_BUDGET = 4096 * 64
+#
+# The 16 MiB figure is XLA's default --xla_tpu_scoped_vmem_limit_kib —
+# the compiler's per-kernel scratch budget, NOT the physical VMEM (which
+# is 128 MiB on v4/v5p/v6 cores and 64+64 MiB on v5e's paired cores; the
+# default limit is the same across current generations, which is why the
+# calibrated bound transfers). An operator raising the XLA flag should
+# set FTL_SCOPED_VMEM_KIB to match and the S*D bound scales linearly
+# with it (the residency is linear in S*D).
+SCOPED_VMEM_BYTES = int(os.environ.get("FTL_SCOPED_VMEM_KIB", "16384")) * 1024
+RESIDENT_BWD_SD_BUDGET = (4096 * 64) * SCOPED_VMEM_BYTES // (16 * 2**20)
 
 
 def _fused_bwd_fits(s: int, d: int) -> bool:
@@ -126,6 +143,53 @@ def _prescale_q(q_ref_slice, scale):
     """
     return (q_ref_slice.astype(jnp.float32) * (scale * LOG2E)).astype(
         q_ref_slice.dtype)
+
+
+def _rope_j(d: int):
+    """The (D, D) pair-rotation matrix J of the interleaved RoPE convention:
+    ``(x @ J)[2j] = -x[2j+1]`` and ``(x @ J)[2j+1] = x[2j]``.
+
+    Lets the kernels apply RoPE as ``x*cos2 + (x@J)*sin2`` — one tiny MXU
+    matmul instead of even/odd lane shuffles (which Mosaic lowers poorly)
+    or an XLA-side rope whose strided-pair reshapes force the fp32
+    relayout-copy family at the custom-call boundary (BASELINE.md round-4
+    profile). Entries are exactly +-1, so the product is exact in fp32.
+    """
+    r = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
+    plus = (c == r + 1) & (r % 2 == 0)
+    minus = (c == r - 1) & (r % 2 == 1)
+    return (jnp.where(plus, 1.0, 0.0)
+            + jnp.where(minus, -1.0, 0.0)).astype(jnp.float32)
+
+
+def _rope_rot(x, c, s, scale_const=None):
+    """Interleaved-pair RoPE rotation of a (rows, D) tile, fp32 internal.
+
+    ``c``/``s`` are (rows, D) fp32 interleave-duplicated tables
+    (``c[r, 2j] == c[r, 2j+1] == cos(angle_j(r))``). With ``scale_const``
+    the softmax prescale (scale * log2(e), see _prescale_q) is folded in.
+    Rounds back to ``x.dtype`` — the same rounding point as the XLA-side
+    ``apply_rope`` + ``_prescale_q`` chain, so backward recomputation of
+    ``exp2(s - lse)`` stays exact."""
+    xf = x.astype(jnp.float32)
+    xj = jax.lax.dot_general(xf, _rope_j(x.shape[-1]), (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    out = xf * c + xj * s
+    if scale_const is not None:
+        out = out * scale_const
+    return out.astype(x.dtype)
+
+
+def _rope_rot_t(g, c, s):
+    """Transpose (= inverse) rotation applied to an fp32 cotangent tile:
+    ``rot^T(g) = g*c - (g*s) @ J`` (J^T = -J; the duplicated-halves
+    structure of the tables makes s commute with the pair swap). The
+    backward kernels emit dq/dk through this — gradients w.r.t. the RAW
+    pre-rope q/k, so no XLA-side rope backward exists at all."""
+    return g * c - jax.lax.dot_general(
+        g * s, _rope_j(g.shape[-1]), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _causal_select(s, q_start, k_start):
@@ -181,6 +245,10 @@ def _active_tiles(s: int):
         return ((STREAM_FWD_BLOCK_Q, STREAM_FWD_BLOCK_K),
                 (STREAM_DQ_BLOCK_Q, STREAM_DQ_BLOCK_K),
                 (STREAM_DKV_BLOCK_Q, STREAM_DKV_BLOCK_K))
+    if s > STREAM_THRESHOLD:
+        return ((MID_FWD_BLOCK_Q, MID_FWD_BLOCK_K),
+                (DQ_BLOCK_Q, DQ_BLOCK_K),
+                (DKV_BLOCK_Q, DKV_BLOCK_K))
     return ((FWD_BLOCK_Q, FWD_BLOCK_K),
             (DQ_BLOCK_Q, DQ_BLOCK_K),
             (DKV_BLOCK_Q, DKV_BLOCK_K))
@@ -277,12 +345,33 @@ def _k_block_bounds(q_start, block_q, s_k, block_k, causal):
     return n_full, n_total
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                scale: float, causal: bool):
+def _fwd_kernel(*refs, block_k: int, scale: float, causal: bool,
+                rope: bool = False, group: int = 1):
     # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D);
     # lse_ref: (1, 1, block_q, 1) — the resident family is always legacy
-    # layout (_lse_layout packs the streaming family only)
-    q2 = _prescale_q(q_ref[0, 0], scale)
+    # layout (_lse_layout packs the streaming family only).
+    # rope=True adds (cq, sq) q-row and (ck, sk) full-row table refs plus a
+    # (S, D) scratch holding this KV head's rotated K (computed once per
+    # GQA span — see _rope_rot; q is rotated per tile with the softmax
+    # prescale folded into the tables' scalar).
+    if rope:
+        (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse_ref, k2_scr) = refs
+
+        @pl.when((pl.program_id(2) == 0) & (pl.program_id(1) % group == 0))
+        def _rope_k():
+            k2_scr[...] = _rope_rot(k_ref[0, 0], ck_ref[...], sk_ref[...])
+
+        q2 = _rope_rot(q_ref[0, 0], cq_ref[...], sq_ref[...], scale * LOG2E)
+
+        def k_at(start):
+            return k2_scr[pl.ds(start, block_k), :]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        q2 = _prescale_q(q_ref[0, 0], scale)
+
+        def k_at(start):
+            return k_ref[0, 0, pl.ds(start, block_k), :]
     block_q, d = q2.shape
     s_k = k_ref.shape[2]
     q_start = pl.program_id(2) * block_q
@@ -290,7 +379,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(j, carry, masked):
         k_start = j * block_k
-        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+        k = k_at(k_start)
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
         return _online_softmax_step(q2, k, v, carry, q_start, k_start, masked)
 
@@ -305,10 +394,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0, 0] = (m + jnp.log2(l))[:, None]  # base-2, internal only
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
-                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                      block_k: int, scale: float, causal: bool, group: int,
-                      packed: bool):
+def _bwd_fused_kernel(*refs, block_k: int, scale: float, causal: bool,
+                      group: int, packed: bool, rope: bool = False):
     """Fused resident backward: dq, dk and dv from ONE pass over the score
     tiles.
 
@@ -326,7 +413,20 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     k/v: (1, 1, S, D) and dk/dv out: (1, 1, S, D) at KV head h // group
     (their blocks are revisited across the span, written back on the last
     step); lse: (1, 1, block_q, 1).
+
+    rope=True adds (cq, sq) q-row and (ck, sk) full-row RAW table refs plus
+    a (S, D) rotated-K scratch: scores recompute the forward's exact
+    rotation; dq/dk are emitted through the transpose rotation
+    (_rope_rot_t) so the kernel's outputs are gradients w.r.t. the raw
+    pre-rope q/k — no XLA-side rope backward exists.
     """
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, cq_ref, sq_ref,
+         ck_ref, sk_ref, dq_ref, dk_ref, dv_ref,
+         dk_scr, dv_scr, k2_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+         dq_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
     hi = pl.program_id(1)
     qi = pl.program_id(2)
     n_qi = pl.num_programs(2)
@@ -335,8 +435,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
+        if rope:
+            k2_scr[...] = _rope_rot(k_ref[0, 0], ck_ref[...], sk_ref[...])
 
-    q2 = _prescale_q(q_ref[0, 0], scale)
+    if rope:
+        q2 = _rope_rot(q_ref[0, 0], cq_ref[...], sq_ref[...], scale * LOG2E)
+    else:
+        q2 = _prescale_q(q_ref[0, 0], scale)
     do = do_ref[0, 0]
     # lse is read once per grid step, so the packed (1, block_q) row (used
     # above STREAM_THRESHOLD, where the forward streamed and emitted the
@@ -350,7 +455,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
 
     def body(j, dq_acc, masked):
         k_start = j * block_k
-        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+        if rope:
+            k = k2_scr[pl.ds(k_start, block_k), :]
+        else:
+            k = k_ref[0, 0, pl.ds(k_start, block_k), :]
         v = v_ref[0, 0, pl.ds(k_start, block_k), :]
         s = _scores(q2, k, q_start, k_start, masked)
         p = jnp.exp2(s - lse)
@@ -376,11 +484,16 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                            jnp.zeros((block_q, d), jnp.float32))
     dq = jax.lax.fori_loop(n_full, n_total,
                            functools.partial(body, masked=causal), dq)
+    if rope:
+        dq = _rope_rot_t(dq, cq_ref[...], sq_ref[...])
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
     @pl.when((qi == n_qi - 1) & (hi % group == group - 1))
     def _emit():
-        dk_ref[0, 0] = (dk_scr[...] * LN2).astype(dk_ref.dtype)
+        dk = dk_scr[...]
+        if rope:
+            dk = _rope_rot_t(dk, ck_ref[...], sk_ref[...])
+        dk_ref[0, 0] = (dk * LN2).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -398,13 +511,23 @@ def _stream_bounds(ki, q_start, block_q, n_k, block_k, causal):
     return ki < n_total, ki >= n_full, n_total
 
 
-def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       m_scr, l_scr, acc_scr, *, block_q: int, block_k: int,
-                       scale: float, causal: bool, packed: bool):
+def _fwd_stream_kernel(*refs, block_q: int, block_k: int,
+                       scale: float, causal: bool, packed: bool,
+                       rope: bool = False):
     # grid (b, h, qi, ki), ki innermost/sequential. q_ref/o_ref:
     # (1, 1, block_q, D) at qi; k_ref/v_ref: (1, 1, block_k, D) at ki;
     # lse_ref: (1, 1, 1, block_q). Scratch (fp32, persists across ki):
     # m/l (block_q, 1), acc (block_q, D).
+    # rope=True adds (cq, sq) q-row tables at qi and (ck, sk) k-row
+    # tables at ki (same clamped index map as k/v); q and the k tile are
+    # rotated per step — the tile is re-fetched per (qi, ki) anyway, so
+    # there is no span to cache across.
+    if rope:
+        (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * block_q
@@ -421,9 +544,15 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(useful)
     def _step():
-        q2 = _prescale_q(q_ref[0, 0], scale)
+        if rope:
+            q2 = _rope_rot(q_ref[0, 0], cq_ref[...], sq_ref[...],
+                           scale * LOG2E)
+            k = _rope_rot(k_ref[0, 0], ck_ref[...], sk_ref[...])
+        else:
+            q2 = _prescale_q(q_ref[0, 0], scale)
+            k = k_ref[0, 0]
         carry = (m_scr[...][:, 0], l_scr[...][:, 0], acc_scr[...])
-        m, l, acc = _online_softmax_step(q2, k_ref[0, 0], v_ref[0, 0], carry,
+        m, l, acc = _online_softmax_step(q2, k, v_ref[0, 0], carry,
                                          q_start, k_start, masked)
         m_scr[...] = m[:, None]
         l_scr[...] = l[:, None]
@@ -437,15 +566,23 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = lse[None, :] if packed else lse[:, None]
 
 
-def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
-                      dq_ref, dq_scr, delta_scr, lse_scr, *, block_q: int,
+def _dq_stream_kernel(*refs, block_q: int,
                       block_k: int, scale: float, causal: bool,
-                      packed: bool):
+                      packed: bool, rope: bool = False):
     # grid (b, h, qi, ki), ki innermost. Same tiling as _fwd_stream_kernel
     # plus do/o at qi; lse: (1, 1, 1, block_q). Scratch: dq (block_q, D)
     # fp32, delta and column-oriented lse (block_q, 1) fp32, all persisting
     # across ki (delta/lse depend only on the q tile, so they are computed
     # once at ki == 0).
+    # rope=True adds (cq, sq) / (ck, sk) table refs plus a rotated-q2
+    # scratch (cached at ki == 0 — the rotation depends only on the q
+    # tile); k tiles rotate per step; dq emits through _rope_rot_t.
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, cq_ref, sq_ref,
+         ck_ref, sk_ref, dq_ref, dq_scr, delta_scr, lse_scr, q2_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+         dq_ref, dq_scr, delta_scr, lse_scr) = refs
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * block_q
@@ -456,44 +593,65 @@ def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
         delta_scr[...] = _delta(do_ref[0, 0], o_ref[0, 0])
         lse_scr[...] = _read_lse(lse_ref, 0, packed)
+        if rope:
+            q2_scr[...] = _rope_rot(q_ref[0, 0], cq_ref[...], sq_ref[...],
+                                    scale * LOG2E)
 
     useful, masked, n_total = _stream_bounds(ki, q_start, block_q, n_k,
                                              block_k, causal)
 
     @pl.when(useful)
     def _step():
-        q2 = _prescale_q(q_ref[0, 0], scale)
+        if rope:
+            q2 = q2_scr[...]
+            k = _rope_rot(k_ref[0, 0], ck_ref[...], sk_ref[...])
+        else:
+            q2 = _prescale_q(q_ref[0, 0], scale)
+            k = k_ref[0, 0]
         dq_scr[...] = dq_scr[...] + _dq_tile(
-            q2, k_ref[0, 0], v_ref[0, 0], do_ref[0, 0], lse_scr[...],
+            q2, k, v_ref[0, 0], do_ref[0, 0], lse_scr[...],
             delta_scr[...], q_start, k_start, masked)
 
     @pl.when(ki == n_total - 1)
     def _emit():
-        dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+        dq = dq_scr[...]
+        if rope:
+            dq = _rope_rot_t(dq, cq_ref[...], sq_ref[...])
+        dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
-                       dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+def _dkv_stream_kernel(*refs, block_q: int,
                        block_k: int, scale: float, causal: bool,
-                       packed: bool):
+                       packed: bool, rope: bool = False):
     # grid (b, kv_head, ki, qi), qi innermost. k/v/dk/dv: (1, 1, block_k, D)
     # at ki; q/do/o: (1, G, block_q, D) at qi; lse: (1, G, 1, block_q).
     # delta is recomputed per (g, qi) step — negligible next to the tile's
     # matmuls, and qi is the INNER grid axis so a single-tile cache cannot
     # hold it across the k rows.
     # Scratch dk/dv (block_k, D) fp32, persists across qi.
+    # rope=True adds (cq, sq) q-row tables at qi and (ck, sk) k-row tables
+    # at ki, plus a rotated-k scratch cached at qi == 0 (the k tile is
+    # this grid row's constant); q rotates per (g, step) — the tables are
+    # head-independent; dk emits through _rope_rot_t.
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, cq_ref, sq_ref,
+         ck_ref, sk_ref, dk_ref, dv_ref, dk_scr, dv_scr, k2_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     qi = pl.program_id(3)
     n_q = pl.num_programs(3)
     k_start = pl.program_id(2) * block_k
     q_start = qi * block_q
     group = q_ref.shape[1]
-    k = k_ref[0, 0]
     v = v_ref[0, 0]
 
     @pl.when(qi == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
+        if rope:
+            k2_scr[...] = _rope_rot(k_ref[0, 0], ck_ref[...], sk_ref[...])
 
     if causal:
         j_start = k_start // block_q
@@ -505,9 +663,14 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
 
     @pl.when(useful)
     def _step():
+        k = k2_scr[...] if rope else k_ref[0, 0]
         dk_acc, dv_acc = dk_scr[...], dv_scr[...]
         for g in range(group):  # static loop: accumulate the GQA group
-            q2 = _prescale_q(q_ref[0, g], scale)
+            if rope:
+                q2 = _rope_rot(q_ref[0, g], cq_ref[...], sq_ref[...],
+                               scale * LOG2E)
+            else:
+                q2 = _prescale_q(q_ref[0, g], scale)
             dk_c, dv_c = _dkv_tile(q2, k, v, do_ref[0, g],
                                    _read_lse(lse_ref, g, packed),
                                    _delta(do_ref[0, g], o_ref[0, g]),
@@ -517,7 +680,10 @@ def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
 
     @pl.when(qi == n_q - 1)
     def _emit():
-        dk_ref[0, 0] = (dk_scr[...] * LN2).astype(dk_ref.dtype)
+        dk = dk_scr[...]
+        if rope:
+            dk = _rope_rot_t(dk, ck_ref[...], sk_ref[...])
+        dk_ref[0, 0] = (dk * LN2).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -555,6 +721,15 @@ def _flash_fwd(q, k, v, causal, interpret):
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
+    out, lse = _flash_fwd_t(qt, kt, vt, causal, interpret)
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def _flash_fwd_t(qt, kt, vt, causal, interpret, rope_tables=None):
+    # Head-major (B, H, S, D) operands — heads are a grid axis.
+    # rope_tables: optional (cos2, sin2) interleave-duplicated (S, D) fp32
+    # tables — the kernels then apply RoPE to q/k tiles in VMEM
+    # (flash_attention_rope); q/k arrive RAW.
     b, h, s, d = qt.shape
     kv_heads = kt.shape[1]
     group = h // kv_heads
@@ -569,7 +744,7 @@ def _flash_fwd(q, k, v, causal, interpret):
         lse_spec = pl.BlockSpec((1, 1, block_q, 1),
                                 lambda bi, hi, qi, *_: (bi, hi, qi, 0))
     out_shape = [
-        jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        jax.ShapeDtypeStruct(qt.shape, qt.dtype),
         jax.ShapeDtypeStruct(lse_shape, jnp.float32),
     ]
     out_specs = [
@@ -577,26 +752,37 @@ def _flash_fwd(q, k, v, causal, interpret):
         lse_spec,
     ]
 
+    rope = rope_tables is not None
     if s <= STREAM_THRESHOLD:
         kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
-                                   causal=causal)
+                                   causal=causal, rope=rope, group=group)
+        in_specs = [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        ]
+        operands = (qt, kt, vt)
+        scratch = []
+        if rope:
+            cq_spec = pl.BlockSpec((block_q, d), lambda bi, hi, qi: (qi, 0))
+            ck_spec = pl.BlockSpec((s, d), lambda bi, hi, qi: (0, 0))
+            in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
+            operands += (*rope_tables, *rope_tables)
+            scratch = [pltpu.VMEM((s, d), kt.dtype)]
         out, lse = pl.pallas_call(
             kernel,
             grid=(b, h, s // block_q),
-            in_specs=[
-                pl.BlockSpec((1, 1, block_q, d),
-                             lambda bi, hi, qi: (bi, hi, qi, 0)),
-                pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-                pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shape,
+            scratch_shapes=scratch,
             interpret=interpret,
-        )(qt, kt, vt)
+        )(*operands)
     else:
         kernel = functools.partial(_fwd_stream_kernel, block_q=block_q,
                                    block_k=block_k, scale=scale,
-                                   causal=causal, packed=packed)
+                                   causal=causal, packed=packed, rope=rope)
         # Causal: grid steps past the diagonal are no-ops in the kernel, so
         # clamp their K/V block index to the last useful one — an unchanged
         # index makes the pipeline skip the HBM fetch entirely.
@@ -604,18 +790,33 @@ def _flash_fwd(q, k, v, causal, interpret):
             def kv_idx(bi, hi, qi, ki):
                 last = (qi * block_q + block_q - 1) // block_k
                 return (bi, hi // group, jnp.minimum(ki, last), 0)
+
+            def ck_idx(bi, hi, qi, ki):
+                last = (qi * block_q + block_q - 1) // block_k
+                return (jnp.minimum(ki, last), 0)
         else:
             def kv_idx(bi, hi, qi, ki):
                 return (bi, hi // group, ki, 0)
+
+            def ck_idx(bi, hi, qi, ki):
+                return (ki, 0)
         kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_idx)
+        in_specs = [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            kv_spec, kv_spec,
+        ]
+        operands = (qt, kt, vt)
+        if rope:
+            cq_spec = pl.BlockSpec((block_q, d),
+                                   lambda bi, hi, qi, ki: (qi, 0))
+            ck_spec = pl.BlockSpec((block_k, d), ck_idx)
+            in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
+            operands += (*rope_tables, *rope_tables)
         out, lse = pl.pallas_call(
             kernel,
             grid=(b, h, s // block_q, s // block_k),
-            in_specs=[
-                pl.BlockSpec((1, 1, block_q, d),
-                             lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-                kv_spec, kv_spec,
-            ],
+            in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shape,
             scratch_shapes=[
@@ -624,21 +825,32 @@ def _flash_fwd(q, k, v, causal, interpret):
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
             interpret=interpret,
-        )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3)), lse
+        )(*operands)
+    return out, lse
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
-    """Pallas backward. Resident family: ONE fused kernel on a
-    (b, h, q-tile) grid producing dq, dk and dv per pass
-    (_bwd_fused_kernel). Streaming family: split kernels — dq via a
-    (head, q-tile, k-step) grid, dk/dv via a (kv-head, k-tile, q-step)
-    grid that accumulates the GQA group in-kernel."""
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
     ot = jnp.transpose(o, (0, 2, 1, 3))
     dot = jnp.transpose(g, (0, 2, 1, 3))
+    dq, dk, dv = _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret)
+    return (jnp.transpose(dq, (0, 2, 1, 3)),
+            jnp.transpose(dk, (0, 2, 1, 3)),
+            jnp.transpose(dv, (0, 2, 1, 3)))
+
+
+def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
+                 rope_tables=None):
+    """Pallas backward on head-major operands. Resident family: ONE fused
+    kernel on a (b, h, q-tile) grid producing dq, dk and dv per pass
+    (_bwd_fused_kernel). Streaming family: split kernels — dq via a
+    (head, q-tile, k-step) grid, dk/dv via a (kv-head, k-tile, q-step)
+    grid that accumulates the GQA group in-kernel.
+
+    rope_tables: optional (cos2, sin2) (S, D) fp32 — in-kernel RoPE mode
+    (q/k and the saved residuals are RAW; dq/dk come back w.r.t. raw)."""
     b, h, s, d = qt.shape
     kv_heads = kt.shape[1]
     group = h // kv_heads
@@ -647,6 +859,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
     dkv_bq, dkv_bk = _blocks(s, dkv_q, dkv_k)
     scale = 1.0 / (d ** 0.5)
     packed = _lse_layout(s)
+    rope = rope_tables is not None
     # delta (rowwise dO . O) is computed inside the kernels from the do/o
     # tiles (see _delta) — no fp32 materialization at the XLA level.
 
@@ -663,21 +876,31 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
         else:
             row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
                                     lambda bi, hi, qi: (bi, hi, qi, 0))
+        in_specs = [q_spec, kv_full, kv_full, q_spec, row_spec, q_spec]
+        operands = (qt, kt, vt, dot, lse, ot)
+        scratch = [pltpu.VMEM((s, d), jnp.float32),
+                   pltpu.VMEM((s, d), jnp.float32)]
+        if rope:
+            cq_spec = pl.BlockSpec((dq_bq, d), lambda bi, hi, qi: (qi, 0))
+            ck_spec = pl.BlockSpec((s, d), lambda bi, hi, qi: (0, 0))
+            in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
+            operands += (*rope_tables, *rope_tables)
+            scratch.append(pltpu.VMEM((s, d), kt.dtype))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, block_k=dq_bk, scale=scale,
-                              causal=causal, group=group, packed=packed),
+                              causal=causal, group=group, packed=packed,
+                              rope=rope),
             grid=(b, h, s // dq_bq),
-            in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, q_spec],
+            in_specs=in_specs,
             out_specs=[pl.BlockSpec((1, 1, dq_bq, d),
                                     lambda bi, hi, qi: (bi, hi, qi, 0)),
                        kv_full, kv_full],
-            out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype),
-                       jax.ShapeDtypeStruct(kt.shape, k.dtype),
-                       jax.ShapeDtypeStruct(vt.shape, v.dtype)],
-            scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
-                            pltpu.VMEM((s, d), jnp.float32)],
+            out_shape=[jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+                       jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+                       jax.ShapeDtypeStruct(vt.shape, vt.dtype)],
+            scratch_shapes=scratch,
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, ot)
+        )(*operands)
     else:
         q_spec = pl.BlockSpec((1, 1, dq_bq, d),
                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
@@ -695,19 +918,37 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
         else:
             row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
                                     lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec]
+        operands = (qt, kt, vt, dot, lse, ot)
+        scratch = [pltpu.VMEM((dq_bq, d), jnp.float32),
+                   pltpu.VMEM((dq_bq, 1), jnp.float32),
+                   pltpu.VMEM((dq_bq, 1), jnp.float32)]
+        if rope:
+            if causal:
+                def dq_ck_idx(bi, hi, qi, ki):
+                    last = (qi * dq_bq + dq_bq - 1) // dq_bk
+                    return (jnp.minimum(ki, last), 0)
+            else:
+                def dq_ck_idx(bi, hi, qi, ki):
+                    return (ki, 0)
+            cq_spec = pl.BlockSpec((dq_bq, d),
+                                   lambda bi, hi, qi, ki: (qi, 0))
+            ck_spec = pl.BlockSpec((dq_bk, d), dq_ck_idx)
+            in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
+            operands += (*rope_tables, *rope_tables)
+            scratch.append(pltpu.VMEM((dq_bq, d), qt.dtype))
         dq = pl.pallas_call(
             functools.partial(_dq_stream_kernel, block_q=dq_bq, block_k=dq_bk,
-                              scale=scale, causal=causal, packed=packed),
+                              scale=scale, causal=causal, packed=packed,
+                              rope=rope),
             grid=(b, h, s // dq_bq, s // dq_bk),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, dq_bq, d),
                                    lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            scratch_shapes=[pltpu.VMEM((dq_bq, d), jnp.float32),
-                            pltpu.VMEM((dq_bq, 1), jnp.float32),
-                            pltpu.VMEM((dq_bq, 1), jnp.float32)],
+            out_shape=jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+            scratch_shapes=scratch,
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, ot)
+        )(*operands)
 
         # Grid over KV heads: block index maps pick up this head's group
         # of G query heads ((1, G, ...) blocks); dk/dv land at KV-head
@@ -731,26 +972,39 @@ def _flash_bwd(q, k, v, o, lse, g, causal, interpret):
         rowgrp_spec = (
             pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
             else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
+        in_specs = [qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
+                    qgrp_spec]
+        operands = (qt, kt, vt, dot, lse, ot)
+        scratch = [pltpu.VMEM((dkv_bk, d), jnp.float32),
+                   pltpu.VMEM((dkv_bk, d), jnp.float32)]
+        if rope:
+            if causal:
+                def dkv_cq_idx(bi, hi, ki, qi):
+                    return (jnp.maximum(qi, ki * dkv_bk // dkv_bq), 0)
+            else:
+                def dkv_cq_idx(bi, hi, ki, qi):
+                    return (qi, 0)
+            cq_spec = pl.BlockSpec((dkv_bq, d), dkv_cq_idx)
+            ck_spec = pl.BlockSpec((dkv_bk, d),
+                                   lambda bi, hi, ki, qi: (ki, 0))
+            in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
+            operands += (*rope_tables, *rope_tables)
+            scratch.append(pltpu.VMEM((dkv_bk, d), kt.dtype))
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
                               block_k=dkv_bk, scale=scale, causal=causal,
-                              packed=packed),
+                              packed=packed, rope=rope),
             grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
-            in_specs=[qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
-                      qgrp_spec],
+            in_specs=in_specs,
             out_specs=[kv_spec, kv_spec],
             out_shape=[
-                jax.ShapeDtypeStruct(kt.shape, k.dtype),
-                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+                jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+                jax.ShapeDtypeStruct(vt.shape, vt.dtype),
             ],
-            scratch_shapes=[pltpu.VMEM((dkv_bk, d), jnp.float32),
-                            pltpu.VMEM((dkv_bk, d), jnp.float32)],
+            scratch_shapes=scratch,
             interpret=interpret,
-        )(qt, kt, vt, dot, lse, ot)
-    dq_out = jnp.transpose(dq, (0, 2, 1, 3))
-    dk_out = jnp.transpose(dk, (0, 2, 1, 3))
-    dv_out = jnp.transpose(dv, (0, 2, 1, 3))
-    return dq_out, dk_out, dv_out
+        )(*operands)
+    return dq, dk, dv
 
 
 def _interpret() -> bool:
@@ -775,3 +1029,76 @@ def _flash_attention_bwd(causal, residuals, g):
 
 
 flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention_bhsd(q, k, v, causal=True):
+    """Head-major entry: q (B,H,S,D), k/v (B,K,S,D) -> (B,H,S,D).
+
+    Identical kernels and math to :func:`flash_attention`, minus the
+    (B,S,H,D) <-> (B,H,S,D) transposes at entry and exit — the caller
+    (models/llama.py ``qkv_layout="bhsd"``) already holds operands in the
+    kernel-native layout, so rope's elementwise fusion writes exactly
+    the layout the custom call consumes and the backward's dq/dk/dv come
+    out in the layout the rope backward wants. This is what eliminates
+    the fp32 relayout-copy family at the custom-call boundary
+    (BASELINE.md round-4)."""
+    out, _ = _flash_fwd_t(q, k, v, causal, _interpret())
+    return out
+
+
+def _flash_attention_bhsd_fwd(q, k, v, causal):
+    out, lse = _flash_fwd_t(q, k, v, causal, _interpret())
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bhsd_bwd(causal, residuals, g):
+    q, k, v, o, lse = residuals
+    return _flash_bwd_t(q, k, v, o, lse, g, causal, _interpret())
+
+
+flash_attention_bhsd.defvjp(_flash_attention_bhsd_fwd,
+                            _flash_attention_bhsd_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def flash_attention_rope(q, k, v, cos2, sin2, causal=True):
+    """Flash attention with RoPE applied INSIDE the kernels.
+
+    q (B,H,S,D) and k/v (B,K,S,D) are RAW (pre-rope) head-major
+    projections; ``cos2``/``sin2`` are (S, D) fp32 interleave-duplicated
+    tables (``cos2[t, 2j] == cos2[t, 2j+1] == cos(t * theta^(-2j/D))`` —
+    build with ``jnp.repeat(cos, 2, axis=-1)`` from the (S, D/2) tables of
+    ops/rope.py). Rotation happens on VMEM tiles via the J-matrix matmul
+    (see _rope_j) with the softmax prescale folded into the q-side pass,
+    and the backward kernels emit dq/dk through the transpose rotation —
+    so NO rotated q/k, fp32 rope intermediate, or rope backward ever
+    exists at the XLA level. That eliminates the rope-adjacent relayout
+    copies and convert fusions that an XLA-side rope pays at the Pallas
+    custom-call boundary (~11 ms/step at the bench shape, BASELINE.md
+    round-4 profile).
+
+    Numerics: the rotation runs in fp32 and rounds to the input dtype at
+    exactly the same point as the ``apply_rope`` + kernel chain; scores,
+    lse and the probability recomputation are bit-compatible with the
+    non-fused kernels fed pre-rotated inputs (tested in
+    tests/test_flash_attention.py)."""
+    out, _ = _flash_fwd_t(q, k, v, causal, _interpret(), (cos2, sin2))
+    return out
+
+
+def _flash_attention_rope_fwd(q, k, v, cos2, sin2, causal):
+    out, lse = _flash_fwd_t(q, k, v, causal, _interpret(), (cos2, sin2))
+    return out, (q, k, v, out, lse, cos2, sin2)
+
+
+def _flash_attention_rope_bwd(causal, residuals, g):
+    q, k, v, o, lse, cos2, sin2 = residuals
+    dq, dk, dv = _flash_bwd_t(q, k, v, o, lse, g, causal, _interpret(),
+                              (cos2, sin2))
+    # The tables are position constants — zero cotangents (DCE'd).
+    return dq, dk, dv, jnp.zeros_like(cos2), jnp.zeros_like(sin2)
+
+
+flash_attention_rope.defvjp(_flash_attention_rope_fwd,
+                            _flash_attention_rope_bwd)

@@ -83,3 +83,27 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
     out_odd = x_even * si + x_odd * c
     out = jnp.stack([out_even, out_odd], axis=-1).reshape(b, s, h, d)
     return out.astype(orig_dtype)
+
+
+def apply_rope_bhsd(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """:func:`apply_rope` for head-major ``x`` of shape (B, H, S, D).
+
+    Same math, same fp32 internal precision — only the broadcast axes
+    move. Used by the ``qkv_layout="bhsd"`` attention path, where q/k are
+    transposed to the flash kernel's native layout *before* rope so the
+    rope fusion's output layout is exactly what the Pallas custom call
+    consumes (no fp32 relayout copies at the boundary; BASELINE.md round-4
+    copy-family breakdown). Prefix positions only — the sequence-parallel
+    paths (which need per-token positions) keep the (B, S, H, D) form.
+    """
+    orig_dtype = x.dtype
+    b, h, s, d = x.shape
+    xf = x.astype(jnp.float32).reshape(b, h, s, d // 2, 2)
+    x_even, x_odd = xf[..., 0], xf[..., 1]
+    c = cos[:s][None, None, :, :]  # (1, 1, S, D/2)
+    si = sin[:s][None, None, :, :]
+    out_even = x_even * c - x_odd * si
+    out_odd = x_even * si + x_odd * c
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(b, h, s, d)
+    return out.astype(orig_dtype)

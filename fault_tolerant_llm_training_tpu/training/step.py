@@ -139,7 +139,7 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
         args = (jnp.broadcast_to(perm[None, :], inputs.shape),)
     from ..ops.cross_entropy import AUTO_THRESHOLD
     from ..ops.fused_ce import (
-        AUTO_MIN_BYTES,
+        auto_min_bytes,
         fused_head_xent,
         sharded_fused_head_xent,
     )
@@ -154,7 +154,7 @@ def model_loss(model, params, inputs, labels, microbatches: int = 0,
         * (inputs.shape[1] // shard_size(inputs.shape[1], "seq"))
         * (cfg.vocab_size // vocab_shards if cfg is not None else 0) * 6)
     fused = (cfg is not None and cfg.vocab_size >= AUTO_THRESHOLD
-             and logits_bytes > AUTO_MIN_BYTES)
+             and logits_bytes > auto_min_bytes())
 
     # One forward (with the MoE routers' sown aux when training), one loss
     # assembly — the fused path only changes WHICH function maps the

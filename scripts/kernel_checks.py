@@ -87,6 +87,60 @@ def check_flash_parity(s, h, kv, d, dtype=jnp.bfloat16):
     return ok
 
 
+def check_rope_fused_parity(s, h, kv, d, dtype=jnp.bfloat16):
+    """In-kernel rope (the rope_impl='fused' production default) vs
+    XLA-side apply_rope + the same flash kernels, compiled on the chip."""
+    from fault_tolerant_llm_training_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_rope,
+    )
+    from fault_tolerant_llm_training_tpu.ops.rope import (
+        apply_rope,
+        precompute_rope,
+    )
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, s, h, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((1, s, kv, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((1, s, kv, d)), dtype)
+    cos, sin = precompute_rope(d, s, 10000.0)
+    cos2 = jnp.repeat(cos, 2, axis=-1)
+    sin2 = jnp.repeat(sin, 2, axis=-1)
+
+    def f_ref(q, k, v):
+        return flash_attention(apply_rope(q, cos, sin),
+                               apply_rope(k, cos, sin), v, True)
+
+    def f_rope(q, k, v):
+        qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+        return jnp.transpose(
+            flash_attention_rope(qt, kt, vt, cos2, sin2, True), (0, 2, 1, 3))
+
+    want = jax.jit(f_ref)(q, k, v)
+    got = jax.jit(f_rope)(q, k, v)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    gx = jax.jit(jax.grad(
+        lambda *a: jnp.sum(f_ref(*a).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.jit(jax.grad(
+        lambda *a: jnp.sum(f_rope(*a).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    gerr = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b.astype(jnp.float32))))
+               for a, b in zip(gx, gf))
+    scale = float(jnp.max(jnp.abs(want.astype(jnp.float32)))) or 1.0
+    gscale = max(float(jnp.max(jnp.abs(a.astype(jnp.float32))))
+                 for a in gx) or 1.0
+    ok = err / scale < 2e-2 and gerr / gscale < 5e-2
+    print(json.dumps({
+        "check": f"rope_fused_vs_xla_rope_onchip s={s} h={h} kv={kv} d={d}",
+        "max_abs_err_out": err, "max_abs_err_grad": gerr,
+        "rel_out": err / scale, "rel_grad": gerr / gscale, "ok": ok,
+    }), flush=True)
+    return ok
+
+
 def check_ring_carry_64k(s=65536, sp=8, h=4, kv=2, d=64):
     """Last-ring-position carry-kernel math == streaming flash at S=64k."""
     from fault_tolerant_llm_training_tpu.ops.flash_attention import (
@@ -157,6 +211,8 @@ def main():
     ok &= check_flash_parity(2048, 12, 12, 64)   # resident, bench shape
     ok &= check_flash_parity(4096, 4, 2, 64)     # streamed fwd + fused bwd, GQA
     ok &= check_flash_parity(16384, 4, 2, 64)    # split streaming bwd, GQA
+    ok &= check_rope_fused_parity(2048, 12, 12, 64)  # in-kernel rope, bench
+    ok &= check_rope_fused_parity(4096, 4, 2, 64)    # rope + streamed fwd
     ok &= check_ring_carry_64k()
     sys.exit(0 if ok else 1)
 

@@ -122,6 +122,85 @@ def test_streaming_kernels_match(s, h, kv, d, causal, long_tiles,
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.parametrize("s,h,kv,d,family", [
+    (512, 4, 4, 64, "resident"),    # MHA, fused resident backward
+    (512, 4, 2, 64, "resident"),    # GQA span rope-K scratch reuse
+    (512, 4, 2, 64, "streaming"),   # split streaming kernels + rope
+    (768, 2, 2, 32, "streaming"),   # non-128-aligned -> legacy lse + rope
+])
+def test_rope_fused_matches_xla_rope(s, h, kv, d, family, monkeypatch):
+    """flash_attention_rope (RoPE inside the kernels via the J-matrix
+    rotation, dq/dk emitted through the transpose rotation) must agree
+    with apply_rope + flash_attention on raw q/k — forward and gradients,
+    across both kernel families and GQA. This is the default TPU rope
+    path (cfg.rope_impl='fused', BASELINE.md round 4)."""
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+    from fault_tolerant_llm_training_tpu.ops.rope import (
+        apply_rope,
+        precompute_rope,
+    )
+    if family == "streaming":
+        monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
+        monkeypatch.setattr(fa, "RESIDENT_BWD_SD_BUDGET", 0)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((2, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, s, kv, d)), jnp.float32)
+    cos, sin = precompute_rope(d, s, 10000.0)
+    cos2 = jnp.repeat(cos, 2, axis=-1)
+    sin2 = jnp.repeat(sin, 2, axis=-1)
+
+    def f_ref(q, k, v):
+        return fa.flash_attention(apply_rope(q, cos, sin),
+                                  apply_rope(k, cos, sin), v, True)
+
+    def f_rope(q, k, v):
+        qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+        return jnp.transpose(
+            fa.flash_attention_rope(qt, kt, vt, cos2, sin2, True),
+            (0, 2, 1, 3))
+
+    np.testing.assert_allclose(np.asarray(f_rope(q, k, v)),
+                               np.asarray(f_ref(q, k, v)),
+                               rtol=2e-4, atol=2e-5)
+    g_ref = jax.grad(lambda *a: jnp.sum(f_ref(*a) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_rope = jax.grad(lambda *a: jnp.sum(f_rope(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_rope):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_bhsd_entry_matches_bshd():
+    """flash_attention_bhsd (head-major entry, no internal transposes)
+    computes the identical function to flash_attention on transposed
+    operands — forward and gradients."""
+    from fault_tolerant_llm_training_tpu.ops.flash_attention import (
+        flash_attention_bhsd,
+    )
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 256, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 256, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 256, 2, 32)), jnp.float32)
+
+    def f_b(q, k, v):
+        qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+        return jnp.transpose(flash_attention_bhsd(qt, kt, vt, True),
+                             (0, 2, 1, 3))
+
+    np.testing.assert_allclose(np.asarray(f_b(q, k, v)),
+                               np.asarray(flash_attention(q, k, v, True)),
+                               rtol=1e-6, atol=1e-7)
+    g_ref = jax.grad(lambda *a: jnp.sum(flash_attention(*a, True) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_b = jax.grad(lambda *a: jnp.sum(f_b(*a) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def _check_gradients(s, h, kv, d, causal=True, batch=1, seed=1):
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((batch, s, h, d)), jnp.float32)

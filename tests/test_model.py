@@ -123,3 +123,69 @@ def test_embed_one_hot_matches_gather():
         {"params": params}, t)
     np.testing.assert_allclose(np.asarray(l_gather), np.asarray(l_onehot),
                                rtol=1e-6, atol=1e-6)
+
+
+def _tiny_fp32(**kw):
+    return get_config("tiny", dtype=jnp.float32, param_dtype=jnp.float32,
+                      **kw)
+
+
+def test_fused_projections_same_tree_and_function():
+    """cfg.fused_w13 / cfg.fused_qkv keep the param tree (names, shapes,
+    init values) byte-identical to the separate nn.Dense modules — the
+    concat happens on the weight side at compute time — and compute the
+    same function up to reduction order (BASELINE.md round 4: fused_w13
+    is the default, +2.2% headline; fused_qkv is a measured rejection
+    kept as an option)."""
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 512, (2, 128)), jnp.int32)
+    base = _tiny_fp32(fused_w13=False, fused_qkv=False)
+    m0 = Transformer(base)
+    p0 = m0.init(jax.random.PRNGKey(0), toks)["params"]
+    ref = m0.apply({"params": p0}, toks)
+    for kw in (dict(fused_w13=True), dict(fused_qkv=True),
+               dict(fused_w13=True, fused_qkv=True)):
+        m = Transformer(_tiny_fp32(**kw))
+        p = m.init(jax.random.PRNGKey(0), toks)["params"]
+        assert (jax.tree_util.tree_structure(p)
+                == jax.tree_util.tree_structure(p0)), kw
+        for a, b in zip(jax.tree_util.tree_leaves(p),
+                        jax.tree_util.tree_leaves(p0)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        out = m.apply({"params": p0}, toks)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5, err_msg=str(kw))
+
+
+def test_rope_impl_fused_matches_xla_in_model():
+    """The model's rope_impl='fused' branch (in-kernel rope, the TPU
+    default) equals the rope_impl='xla' pallas path — logits and grads.
+    Forced onto the pallas path explicitly so the branch runs (interpret
+    mode) on CPU."""
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, 512, (2, 128)), jnp.int32)
+    m_x = Transformer(_tiny_fp32(attention_impl="pallas", rope_impl="xla"))
+    m_f = Transformer(_tiny_fp32(attention_impl="pallas", rope_impl="fused"))
+    p = m_x.init(jax.random.PRNGKey(0), toks)["params"]
+    out_x = m_x.apply({"params": p}, toks)
+    out_f = m_f.apply({"params": p}, toks)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
+                               rtol=1e-4, atol=1e-5)
+
+    def loss(model, params):
+        # sin keeps the cotangents bounded — a sum-of-squares loss over
+        # all logits produces O(100)-magnitude grads whose fp32
+        # association noise swamps the comparison
+        return jnp.sum(jnp.sin(model.apply({"params": params}, toks)))
+
+    g_x = jax.grad(lambda p: loss(m_x, p))(p)
+    g_f = jax.grad(lambda p: loss(m_f, p))(p)
+    # Per-leaf relative norm: the two rope paths are mathematically
+    # identical but associate fp32 sums differently, and two layers of
+    # compounding amplifies isolated elements past any sane elementwise
+    # bound while the leaf-level agreement stays ~1e-6.
+    for a, b in zip(jax.tree_util.tree_leaves(g_x),
+                    jax.tree_util.tree_leaves(g_f)):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        rel = np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-12)
+        assert rel < 1e-4, rel

