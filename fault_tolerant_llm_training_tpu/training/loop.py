@@ -158,11 +158,13 @@ class Trainer:
         # --- data (ref: train.py:27-34) ---
         logger.info("Setting up DataLoaders...")
         self.tokenizer = load_tokenizer(cfg.tokenizer_name_or_path)
+        shuffle_seed = cfg.seed if cfg.shuffle else None
         if cfg.data_loading == "map":
             dataset = ParquetDataset(cfg.dataset, self.tokenizer,
                                      cfg.sequence_length,
                                      cfg.batch_size * cfg.training_steps,
-                                     pretokenize_dir=cfg.pretokenize_dir)
+                                     pretokenize_dir=cfg.pretokenize_dir,
+                                     shuffle_seed=shuffle_seed)
             collator = CollatorForCLM(cfg.sequence_length,
                                       self.tokenizer.pad_token_id)
             self.loader = DataLoader(dataset, cfg.batch_size, collator)
@@ -170,7 +172,7 @@ class Trainer:
             dataset = IterableParquetDataset(
                 cfg.dataset, self.tokenizer, cfg.sequence_length,
                 bos_token_id=self.tokenizer.bos_token_id,
-                legacy=cfg.legacy_packing)
+                legacy=cfg.legacy_packing, shuffle_seed=shuffle_seed)
             self.loader = DataLoader(dataset, cfg.batch_size)
         self._setup_check()
 

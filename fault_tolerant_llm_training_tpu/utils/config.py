@@ -66,6 +66,7 @@ class TrainConfig:
     remat: bool = False  # jax.checkpoint each block (trade FLOPs for HBM)
     master_weights: str = "same"  # same | fp32 (fp32 optimizer master copy)
     data_loading: str = "map"  # map (ParquetDataset path) | packed (iterable)
+    shuffle: bool = False  # seeded per-epoch shuffle (default: reference's strict doc order)
     pretokenize_dir: str = ""  # cache dir for one-time tokenization (map path)
     legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
     checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
@@ -202,6 +203,13 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         choices=["same", "fp32"])
     parser.add_argument("--data-loading", type=str, default="map",
                         choices=["map", "packed"])
+    parser.add_argument("--shuffle", action="store_true",
+                        help="Deterministic per-epoch data shuffling keyed "
+                             "on --seed; iterator state stays a single "
+                             "position, so bit-exact O(1) resume is "
+                             "preserved (the reference trains in strict "
+                             "document order, which produces order "
+                             "artifacts in multi-epoch runs)")
     parser.add_argument("--pretokenize-dir", type=str, default="",
                         help="Tokenize the corpus once into a memmap cache "
                              "here; steady-state loading becomes a row "
