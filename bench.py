@@ -65,13 +65,22 @@ def main():
         # so max is the honest estimator and one bad pass cannot poison
         # the recorded result.
         passes = 2 if on_tpu else 1
-        dt = float("inf")
+        pass_times = []
         for _ in range(passes):
             t0 = time.perf_counter()
             for _ in range(steps):
                 state, metrics = step_fn(state, toks, labels)
             hard_sync(metrics)
-            dt = min(dt, time.perf_counter() - t0)
+            pass_times.append(time.perf_counter() - t0)
+        dt = min(pass_times)
+        # Both pass times are recorded (ADVICE r3): best-of-N absorbs
+        # one-off tunnel stalls, but a PERSISTENT gap between passes
+        # (periodic recompilation, host interference on every other pass)
+        # must stay visible in the artifact rather than being silently
+        # reported as the optimistic tail.
+        if max(pass_times) > 1.05 * dt:
+            print(f"bench: pass spread {[round(t, 2) for t in pass_times]} s "
+                  f"(reporting best)", file=sys.stderr, flush=True)
         assert np.isfinite(float(metrics["loss"]))
 
     tokens_per_sec = batch * seq * steps / dt
@@ -82,6 +91,7 @@ def main():
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_TOKENS_PER_SEC, 3),
+        "pass_seconds": [round(t, 3) for t in pass_times],
     }))
 
 

@@ -94,6 +94,22 @@ class TransformerConfig:
     # store-everything forward scan whose autodiff replays the reverse
     # pipeline — memory O(microbatches), kept as a fallback/baseline.
     pp_schedule: str = "1f1b"
+    # Unroll each pipeline STAGE's layer loop (a static Python loop over
+    # the stage's slice of the layer stack) instead of lax.scan-ing it.
+    # Params stay scan-form/stacked (the 'pipe' sharding needs the
+    # leading layer axis); only the stage body's control flow changes —
+    # this is the PP analogue of layer_impl="loop", recovering the
+    # cross-layer fusion whose loss costs the scan trunk ~19% on TPU
+    # (BASELINE.md round 2). Measured 20% faster than the scanned stage
+    # body on the CPU mesh (scripts/pp_bench.py, BASELINE.md round 4)
+    # with bit-identical losses — but default OFF: the closest measured
+    # TPU datapoint for stacked-param slice unrolling (nn.scan(unroll=N),
+    # models/llama.py NOTE) REGRESSED 22% on chip, and --pp cannot be
+    # timed on this repo's single chip. The static-Python-loop form here
+    # avoids the in-scan slicing that datapoint blamed, so it may well
+    # win on TPU like the loop trunk does — opt in and A/B when real
+    # multi-chip hardware exists.
+    pp_stage_unroll: bool = False
     remat: bool = False
     # --- Mixture of Experts (models/moe.py; 0 experts = dense reference
     # FFN). Experts shard over the mesh's 'expert' axis (--ep). ---

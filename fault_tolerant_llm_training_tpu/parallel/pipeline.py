@@ -58,6 +58,32 @@ autodiff yields the reverse pipeline (memory O(M)).
 
 The jitted results compute exactly the same function as the plain trunk
 (tests/test_pipeline.py pins loss/trajectory equivalence on the CPU mesh).
+
+**Analytic bubble / efficiency model (SPMD lockstep).** Let F and B be one
+stage's forward and backward tick cost (B ~ 2F). Every device executes the
+same compiled tick body, so a tick costs F+B wall whether or not this
+stage has work that tick (idle slots are zero-masked compute, not idle
+time — the price of single-program pipelining on an SPMD compiler):
+
+- 1F1B runs ``M + 2P - 1`` combined ticks -> wall = (M+2P-1)(F+B);
+  bubble fraction = (2P-1)/(M+2P-1)  [M=8, P=2: 27%; M=16: 16%; M=32: 9%]
+- GPipe runs an (M+P-1)-tick forward scan at F plus its autodiff reverse
+  at B -> wall = (M+P-1)(F+B); bubble = (P-1)/(M+P-1)
+  [M=8, P=2: 11%; M=16: 6%]
+
+So in this SPMD formulation 1F1B pays P extra bubble ticks of wall
+relative to GPipe — analytically (M+2P-1)/(M+P-1) = 1.22x at M=8/P=2,
+1.12x at M=16/P=2. Measured on the 8-virtual-device CPU mesh
+(scripts/pp_bench.py, dim-256 4-layer model): **1.26x and 1.15x** — the
+analytic model tracks within 3-4%, the excess being the in-loop head+CE
+and stash-ring bookkeeping. (The asynchronous-dispatch 1F1B of GPU
+frameworks has no such penalty because stages genuinely idle rather than
+execute masked ticks.) Its win is MEMORY: 0.145x GPipe's activation
+allocation at M=8/P=2 (test_pipeline_1f1b_activation_memory), plus
+per-microbatch per-vocab-shard logits — 1F1B is the default because
+activation memory, not wall, is what kills long-context/deep-model PP
+configs, and the wall gap closes as 1/M. Use ``--pp-schedule gpipe``
+when M is small and memory is not binding.
 """
 
 import jax
@@ -97,10 +123,8 @@ def pipeline_hidden(model, params, x, positions, mesh=None,
     stacked = params["layers"]["block"]
 
     def local_layers(stack_local, h, pos):
-        def step(c, layer_params):
-            return block.apply({"params": layer_params}, c, pos), None
-        out, _ = jax.lax.scan(step, h, stack_local)
-        return out
+        return _stage_layers(block, cfg, stack_local, h, pos,
+                             collect_aux=False)[0]
 
     compute_dtype = x.dtype
     b, seq, d = x.shape
@@ -170,6 +194,50 @@ def pipeline_apply(model, params, tokens, mesh=None,
     hidden = pipeline_hidden(model, params, x, positions, mesh=mesh,
                              microbatches=microbatches)
     return model.apply({"params": params}, hidden, method="head")
+
+
+def _stage_layers(block, cfg, stack_local, h, pos, collect_aux):
+    """Apply one stage's slice of the layer stack to ``h``.
+
+    Shared by the GPipe forward (pipeline_hidden) and the 1F1B tick loop
+    (pipeline_value_and_grad) so their per-layer application can never
+    diverge. Control flow follows ``cfg.pp_stage_unroll``: a lax.scan
+    over the stacked params (O(1) compile in stage depth) or a static
+    Python unroll (cross-layer fusion back — measured tradeoffs in
+    configs.py). ``collect_aux`` accumulates the MoE routers' sown aux.
+    Returns (h_out, summed aux — 0.0 when not collecting)."""
+    if cfg.pp_stage_unroll:
+        aux = jnp.zeros((), jnp.float32)
+        n_local = jax.tree_util.tree_leaves(stack_local)[0].shape[0]
+        for i in range(n_local):
+            layer_params = jax.tree_util.tree_map(lambda a: a[i],
+                                                  stack_local)
+            if collect_aux:
+                h, mut = block.apply({"params": layer_params}, h, pos,
+                                     mutable=["losses"])
+                aux = aux + sum(jnp.sum(leaf) for leaf in
+                                jax.tree_util.tree_leaves(mut))
+            else:
+                h = block.apply({"params": layer_params}, h, pos)
+        return h, aux
+    if collect_aux:
+        def step(carry, layer_params):
+            h, aux = carry
+            out, mut = block.apply({"params": layer_params}, h, pos,
+                                   mutable=["losses"])
+            aux = aux + sum(jnp.sum(leaf) for leaf in
+                            jax.tree_util.tree_leaves(mut))
+            return (out, aux), None
+
+        (h, aux), _ = jax.lax.scan(
+            step, (h, jnp.zeros((), jnp.float32)), stack_local)
+        return h, aux
+
+    def step(c, layer_params):
+        return block.apply({"params": layer_params}, c, pos), None
+
+    out, _ = jax.lax.scan(step, h, stack_local)
+    return out, jnp.zeros((), jnp.float32)
 
 
 def _rmsnorm(scale, h, eps):
@@ -279,7 +347,15 @@ def pipeline_value_and_grad(model, params, tokens, labels, mesh=None,
     # MAJOR vocab axis (parallel/sharding.py) so this reshape is
     # reshard-free and stage s's slice is the contiguous [s*Vl, (s+1)*Vl);
     # any 'tensor' sub-sharding stays auto inside the slice.
-    w = params["output"]["kernel"]
+    # Cast the head weight to the COMPUTE dtype, mirroring nn.Dense
+    # (dtype=cfg.dtype) and the fused-CE path (training/step.py casts
+    # head_w the same way): under mixed precision (fp32 master params,
+    # bf16 compute) the in-loop head must round w exactly where the
+    # single-device path does, or the pipelined trajectory diverges from
+    # the path it claims to reproduce (ADVICE r3). dw is assembled in
+    # fp32 and cast to the param dtype on return, same as autodiff of
+    # the cast would produce.
+    w = params["output"]["kernel"].astype(cfg.dtype)
     v = w.shape[1]
     vaxes = vocab_shard_axes(w.shape, mesh)
     # When the vocab dim is indivisible by pp (degenerate configs only —
@@ -310,24 +386,8 @@ def pipeline_value_and_grad(model, params, tokens, labels, mesh=None,
 
     def stage_fn(stack_local, h, pos):
         """This stage's layers; returns (h_out, summed router aux)."""
-        if cfg.moe_experts:
-            def step(carry, layer_params):
-                h, aux = carry
-                out, mut = block.apply({"params": layer_params}, h, pos,
-                                       mutable=["losses"])
-                aux = aux + sum(jnp.sum(leaf) for leaf in
-                                jax.tree_util.tree_leaves(mut))
-                return (out, aux), None
-
-            (h, aux), _ = jax.lax.scan(
-                step, (h, jnp.zeros((), jnp.float32)), stack_local)
-            return h, aux
-
-        def step(c, layer_params):
-            return block.apply({"params": layer_params}, c, pos), None
-
-        out, _ = jax.lax.scan(step, h, stack_local)
-        return out, jnp.zeros((), jnp.float32)
+        return _stage_layers(block, cfg, stack_local, h, pos,
+                             collect_aux=bool(cfg.moe_experts))
 
     def local_head_stats(h_norm, labels_loc, w_local):
         if blocked:
@@ -483,6 +543,9 @@ def pipeline_value_and_grad(model, params, tokens, labels, mesh=None,
         "layers": {"block": jax.tree_util.tree_map(
             lambda g, p: g.astype(p.dtype), dstack, stacked)},
         "norm": {"scale": dscale.astype(scale.dtype)},
-        "output": {"kernel": dw3.reshape(d, v).astype(w.dtype)},
+        # .astype targets the PARAM dtype (w above is the compute-dtype
+        # cast view, which may differ under --master-weights fp32)
+        "output": {"kernel": dw3.reshape(d, v).astype(
+            params["output"]["kernel"].dtype)},
     }
     return (loss, num_valid), grads

@@ -59,6 +59,8 @@ class Trainer:
         self.training_step = 0
         self._resumed = False
         self._last_data_state = None
+        # first periodic save blocks to observe real write wall (see _loop)
+        self._budget_observed = False
         # True when the raised error is deterministic and hits every host at
         # the same step (injection, non-finite grad from replicated metrics)
         # — only then may the exit handler run a *coordinated* save on a pod.
@@ -191,7 +193,9 @@ class Trainer:
             dtype=dtype, param_dtype=param_dtype,
             attention_impl=cfg.attention_impl, embed_impl=cfg.embed_impl,
             sp_layout=cfg.sp_layout, layer_impl=cfg.layer_impl,
-            pp_schedule=cfg.pp_schedule, remat=cfg.remat, **moe_over)
+            pp_schedule=cfg.pp_schedule,
+            pp_stage_unroll=cfg.pp_stage_unroll,
+            remat=cfg.remat, **moe_over)
         if cfg.ep > 1 and not self.model_config.moe_experts:
             raise ValueError("--ep needs an MoE model (--model tiny-moe or "
                              "--moe-experts N)")
@@ -438,7 +442,15 @@ class Trainer:
             self.training_step += 1
             if (cfg.checkpoint_frequency
                     and self.training_step % cfg.checkpoint_frequency == 0):
-                self.save_checkpoint(wait=False, stop_prefetch=False)
+                # The FIRST periodic save blocks to measure the real
+                # write wall against the signal lead (the startup budget
+                # line only extrapolates a 128 MiB probe — ADVICE r3:
+                # on filesystems with throughput cliffs the estimate is
+                # optimistic and the operator must learn BEFORE the first
+                # preemption, not during it). Later saves are async.
+                first = not self._budget_observed
+                self._budget_observed = True
+                self.save_checkpoint(wait=first, stop_prefetch=False)
             if (self._compiled_eval is not None
                     and self.training_step % cfg.eval_frequency == 0):
                 self._evaluate()
@@ -552,6 +564,19 @@ class Trainer:
             logger.info(f"Checkpoint write | {total / 1e9:.2f} GB in "
                         f"{secs:.1f} s ({total / 1e9 / max(secs, 1e-6):.2f} "
                         f"GB/s)")
+            # Re-check the budget against OBSERVED reality (ADVICE r3):
+            # the startup estimate extrapolates a 128 MiB probe, which can
+            # be optimistic on network filesystems with throughput cliffs
+            # at multi-GB writes or uneven host shards. A measured save
+            # that blows the lead is the ground truth the warning exists
+            # for.
+            lead = self.cfg.signal_lead_seconds
+            if secs > lead:
+                logger.warning(
+                    f"Checkpoint budget EXCEEDED (observed): this save took "
+                    f"{secs:.0f} s > the {lead} s signal lead — the startup "
+                    f"estimate was optimistic for this filesystem; a "
+                    f"preemption may outrun the save.")
         return step
 
     def close(self) -> None:
