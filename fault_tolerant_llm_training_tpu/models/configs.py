@@ -68,8 +68,10 @@ class TransformerConfig:
     # fp32 rope intermediate ever materializes at the XLA level, which
     # removes the rope-adjacent relayout-copy family at the custom-call
     # boundary. "fused" engages only on the single-chip pallas path with
-    # prefix positions; other paths (ring/xla/per-token positions) fall
-    # back to "xla" automatically. Default "fused": +3.7% headline and the
+    # prefix positions AND within the fused-backward S*D budget (the
+    # streaming kernels re-rope K per tile fetch, measured net-negative
+    # past S=4096/D=64 — ops/flash_attention.py rope_fused_profitable);
+    # other shapes/paths fall back to "xla" automatically. Default "fused": +3.7% headline and the
     # fp32 relayout-copy family at the custom-call boundary disappears
     # from the profile (BASELINE.md round 4); parity with the xla path is
     # pinned to fp32 noise in tests/test_flash_attention.py.

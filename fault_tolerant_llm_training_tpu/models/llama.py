@@ -166,12 +166,15 @@ class Attention(nn.Module):
         resolved = impl
         if impl in ("auto", "ring"):
             resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from ..ops.flash_attention import rope_fused_profitable
         if (not ring and resolved == "pallas" and positions is None
-                and cfg.rope_impl == "fused"):
+                and cfg.rope_impl == "fused"
+                and rope_fused_profitable(s, dh)):
             # RoPE inside the kernels (ops/flash_attention.py
             # flash_attention_rope): raw head-major q/k/v plus the
             # interleave-duplicated (S, D) tables. No rotated q/k or rope
-            # backward exists at the XLA level.
+            # backward exists at the XLA level. Long-context shapes fall
+            # through to XLA rope (see rope_fused_profitable).
             from ..ops.flash_attention import flash_attention_rope
             cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
             cos2 = jnp.repeat(cos[:s], 2, axis=-1)

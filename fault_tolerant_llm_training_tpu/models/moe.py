@@ -169,7 +169,15 @@ class MoEFeedForward(nn.Module):
             variable_axes={"params": 0},
             split_rngs={"params": True},
             in_axes=0, out_axes=0,
-        )(cfg, name="experts")
+            # Experts keep SPLIT w1/w3 matmuls regardless of the dense
+            # trunk's fused_w13 default: under the expert vmap the fused
+            # form materializes one (E, B, C, 2H) h13 buffer per layer
+            # (160 MB at the bench MoE shape) on top of the capacity
+            # slots, measured to push the bs-8/50k-vocab MoE config over
+            # the 16 GB HBM edge (round 4) — while the fusion's win is a
+            # dense-trunk bandwidth effect the slot-dispatched experts
+            # don't see.
+        )(cfg.replace(fused_w13=False), name="experts")
         expert_out = experts(expert_in)  # (E, B, C, D)
         expert_out = constrain(expert_out, "expert_stack", "batch", None,
                                "act_embed")

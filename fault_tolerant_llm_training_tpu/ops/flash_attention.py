@@ -130,6 +130,20 @@ RESIDENT_BWD_SD_BUDGET = (4096 * 64) * SCOPED_VMEM_BYTES // (16 * 2**20)
 
 def _fused_bwd_fits(s: int, d: int) -> bool:
     return s * d <= RESIDENT_BWD_SD_BUDGET
+
+
+def rope_fused_profitable(s: int, d: int) -> bool:
+    """Whether in-kernel rope (flash_attention_rope) beats XLA-side rope
+    at this shape — the dispatch the model's rope_impl='fused' uses.
+
+    Measured on v5e (BASELINE.md round 4): +3.7% headline at S=2048 and
+    −2.6% attention time at S=4096 (resident/fused-backward region, where
+    K is roped ONCE per span into scratch), but +2.1% at S=8192 and
+    +3.7% at S=16384 — the streaming kernels re-fetch each K tile per
+    (q-tile, k-step) grid visit and the rotation rides every fetch, so
+    the redundant k-rope grows with S while XLA-side rope stays O(S).
+    The boundary is exactly the fused-backward budget."""
+    return _fused_bwd_fits(s, d)
 NEG_INF = -1e30
 LOG2E = math.log2(math.e)
 LN2 = math.log(2.0)

@@ -1,0 +1,93 @@
+"""Long-context attention fwd+bwd timing: in-kernel rope vs XLA rope.
+
+The round-4 default (cfg.rope_impl='fused') moves RoPE into the flash
+kernels for EVERY sequence length on the pallas path — the headline win
+was measured at S=2048 (BASELINE.md round 4); this times the streaming
+regime so the default is validated (or scoped) across the long-context
+curve. B1/H12/D64 fwd+bwd, matching the round-2/3 long-context rows.
+
+Run on the chip:  python scripts/longctx_bench.py [--sizes 4096,8192,...]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes", default="4096,8192,16384,32768")
+    p.add_argument("--iters", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_rope,
+    )
+    from fault_tolerant_llm_training_tpu.ops.rope import (
+        apply_rope,
+        precompute_rope,
+    )
+    from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
+
+    b, h, d = 1, 12, 64
+    for s in (int(x) for x in args.sizes.split(",")):
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        cos, sin = precompute_rope(d, s, 10000.0)
+        cos2 = jnp.repeat(cos, 2, axis=-1)
+        sin2 = jnp.repeat(sin, 2, axis=-1)
+
+        def loss_xla(q, k, v):
+            return jnp.sum(flash_attention(
+                apply_rope(q, cos, sin), apply_rope(k, cos, sin), v,
+                True).astype(jnp.float32) ** 2)
+
+        def loss_rope(q, k, v):
+            qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3))
+                          for x in (q, k, v))
+            return jnp.sum(flash_attention_rope(
+                qt, kt, vt, cos2, sin2, True).astype(jnp.float32) ** 2)
+
+        def timed(loss_fn, tag):
+            # iterate INSIDE one jit with a data dependence so XLA cannot
+            # hoist the work (ROUND_NOTES microbench trap); per-iteration
+            # q perturbation depends on the previous grad.
+            grad = jax.grad(loss_fn, argnums=(0, 1, 2))
+
+            @jax.jit
+            def run(q, k, v):
+                def body(carry, _):
+                    q, k, v = carry
+                    dq, dk, dv = grad(q, k, v)
+                    return (q + 1e-6 * dq.astype(q.dtype), k, v), None
+                (q, _, _), _ = jax.lax.scan(body, (q, k, v), None,
+                                            length=args.iters)
+                return q
+
+            out = run(q, k, v)
+            hard_sync(out)
+            t0 = time.perf_counter()
+            out = run(q, k, v)
+            hard_sync(out)
+            dt = (time.perf_counter() - t0) / args.iters
+            return dt
+
+        t_xla = timed(loss_xla, "xla")
+        t_rope = timed(loss_rope, "rope")
+        print(f"S={s}: xla-rope {t_xla * 1000:.1f} ms  in-kernel rope "
+              f"{t_rope * 1000:.1f} ms  ratio {t_rope / t_xla:.3f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

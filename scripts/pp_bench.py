@@ -18,7 +18,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+_FORCE = "--xla_force_host_platform_device_count=8"
+if _FORCE not in os.environ.get("XLA_FLAGS", ""):
+    # append rather than setdefault: a pre-set XLA_FLAGS must not
+    # silently drop the 8-device mesh this script requires
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " " + _FORCE).strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 

@@ -215,3 +215,16 @@ def _check_gradients(s, h, kv, d, causal=True, batch=1, seed=1):
     for a, b in zip(g_ref, g_flash):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
+
+
+def test_rope_fused_dispatch_boundary():
+    """rope_impl='fused' scopes itself to the fused-backward S*D budget:
+    the streaming kernels re-rope K per tile fetch, measured net-negative
+    past S=4096/D=64 on v5e (BASELINE.md round 4)."""
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+
+    assert fa.rope_fused_profitable(2048, 64)
+    assert fa.rope_fused_profitable(4096, 64)
+    assert not fa.rope_fused_profitable(8192, 64)
+    assert fa.rope_fused_profitable(2048, 128)
+    assert not fa.rope_fused_profitable(4096, 128)  # D=128 halves the S

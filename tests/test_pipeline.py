@@ -336,7 +336,8 @@ def test_pipeline_mixed_precision_matches_single_device(eight_devices):
 
 
 def test_pipeline_stage_unroll_matches_scan(eight_devices):
-    """--pp-stage-unroll (the default) vs the scanned stage body: same
+    """--pp-stage-unroll (opt-in; see models/configs.py for why the
+    scanned body stays the default) vs the scanned stage body: same
     function, bit-comparable trajectory (fp32), through the full 1F1B
     train step."""
     cfg_u = get_config("tiny", **FP32, pp_stage_unroll=True)
