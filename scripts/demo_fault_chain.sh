@@ -17,22 +17,13 @@
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
+. scripts/demo_common.sh
 WORK=${DEMO_WORKDIR:-/tmp/ftl_demo}
 rm -rf "$WORK"
 mkdir -p "$WORK" logs
 
-export JAX_PLATFORMS=cpu
-unset PALLAS_AXON_POOL_IPS || true
-export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_test_compile_cache}
-export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
-
-python - <<EOF
-import numpy as np, pyarrow as pa, pyarrow.parquet as pq
-rng = np.random.default_rng(0)
-words = ['alpha','bravo','charlie','delta','echo','foxtrot']
-docs = [' '.join(rng.choice(words, size=int(rng.integers(20,200)))) for _ in range(256)]
-pq.write_table(pa.table({'text': docs}), '$WORK/train_data.parquet')
-EOF
+demo_cpu_env
+demo_make_parquet "$WORK/train_data.parquet"
 
 COMMON=(--dataset "$WORK/train_data.parquet" --checkpoint-path "$WORK/ckpts"
         --tokenizer-name-or-path byte --model tiny --sequence-length 128

@@ -22,6 +22,11 @@
 #SBATCH --signal=USR1@120
 #SBATCH --no-requeue
 
+# Overridable from the environment so a scheduler-shim harness
+# (scripts/demo_sbatch_chain.sh) can drive THIS script with a small
+# config; the default below is the reference's own shape with fault
+# injection ON (ref: train.sh:21-22).
+if [ -z "${TRAINING_CMD:-}" ]; then
 TRAINING_CMD=" --model gpt2-125m \
                --sequence-length 2048 \
                --batch-size 1 \
@@ -30,6 +35,7 @@ TRAINING_CMD=" --model gpt2-125m \
                --training-steps 1400 \
                --raise-error \
                --error-step 600"
+fi
 
 if [ -n "$1" ]; then
     TRAINING_CMD="$TRAINING_CMD \
@@ -37,4 +43,8 @@ if [ -n "$1" ]; then
 fi
 export WORKDIR="${WORKDIR:-$(pwd)}"
 
+# The resolved command is logged so an environment-supplied TRAINING_CMD
+# (sbatch defaults to --export=ALL) can never silently replace the
+# flagship config without a trace in logs/output_%j.out.
+echo "train.sh: TRAINING_CMD=$TRAINING_CMD"
 exec srun --unbuffered python "$WORKDIR/train.py" $TRAINING_CMD
