@@ -56,6 +56,14 @@ class TransformerConfig:
     # 110.3k vs 113.8k base, and still -2% on top of the other round-4
     # wins) — kept as an option for other generations.
     fused_qkv: bool = False
+    # Contract wo against the flash kernel's head-major output via einsum
+    # instead of transpose+reshape+Dense (rope_impl="fused" path only).
+    # Param tree unchanged (_Kernel). Default ON: +2.1% headline, +0.8%
+    # at bs 16 (BASELINE.md round 4).
+    fused_wo: bool = True
+    # Project q/k/v via 'bsd,dhe->bhse' einsums so they land head-major
+    # (the input-side mirror of fused_wo). Round-4 experiment knob.
+    qkv_einsum: bool = False
     # SwiGLU gate+up in one (D, 2*hidden) matmul, split after. Default ON:
     # +2.2% on the headline bench stacked on the in-kernel rope
     # (BASELINE.md round 4); parity with separate matmuls is reduction-

@@ -141,7 +141,27 @@ class Attention(nn.Module):
         nq, nkv = cfg.n_heads * dh, cfg.kv_heads * dh
         dense = dict(use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=_DENSE_INIT)
-        if cfg.fused_qkv:
+        b, s = x.shape[0], x.shape[1]
+        if cfg.qkv_einsum:
+            # Head-major projections: contract x against the (D, H, dh)
+            # views so q/k/v land directly in the flash kernels'
+            # (B, H, S, D) layout — no activation-side transpose between
+            # projection and kernel (pairs with fused_wo on the output
+            # side). rope_impl='fused' path consumes these as-is; other
+            # paths transpose back below.
+            def proj(name, heads):
+                w = _Kernel((cfg.dim, heads * dh), cfg.param_dtype,
+                            name=name)()
+                return jnp.einsum(
+                    "bsd,dhe->bhse", x,
+                    w.reshape(cfg.dim, heads, dh).astype(cfg.dtype))
+            qt = proj("wq", cfg.n_heads)
+            kt = proj("wk", cfg.kv_heads)
+            vt = proj("wv", cfg.kv_heads)
+            q = jnp.transpose(qt, (0, 2, 1, 3))
+            k = jnp.transpose(kt, (0, 2, 1, 3))
+            v = jnp.transpose(vt, (0, 2, 1, 3))
+        elif cfg.fused_qkv:
             # One (D, (H+2K)*dh) matmul over the concatenated kernels:
             # x is read once instead of three times, and the backward's
             # dx / dW each collapse to one dot (autodiff of the concat is
@@ -150,16 +170,16 @@ class Attention(nn.Module):
             wk = _Kernel((cfg.dim, nkv), cfg.param_dtype, name="wk")()
             wv = _Kernel((cfg.dim, nkv), cfg.param_dtype, name="wv")()
             qkv = x @ jnp.concatenate([wq, wk, wv], axis=1).astype(cfg.dtype)
-            q, k, v = (qkv[..., :nq], qkv[..., nq:nq + nkv],
-                       qkv[..., nq + nkv:])
+            q, k, v = (qkv[..., :nq].reshape(b, s, cfg.n_heads, dh),
+                       qkv[..., nq:nq + nkv].reshape(b, s, cfg.kv_heads, dh),
+                       qkv[..., nq + nkv:].reshape(b, s, cfg.kv_heads, dh))
         else:
-            q = nn.Dense(nq, name="wq", **dense)(x)
-            k = nn.Dense(nkv, name="wk", **dense)(x)
-            v = nn.Dense(nkv, name="wv", **dense)(x)
-        b, s = x.shape[0], x.shape[1]
-        q = q.reshape(b, s, cfg.n_heads, dh)
-        k = k.reshape(b, s, cfg.kv_heads, dh)
-        v = v.reshape(b, s, cfg.kv_heads, dh)
+            q = nn.Dense(nq, name="wq", **dense)(x).reshape(
+                b, s, cfg.n_heads, dh)
+            k = nn.Dense(nkv, name="wk", **dense)(x).reshape(
+                b, s, cfg.kv_heads, dh)
+            v = nn.Dense(nkv, name="wv", **dense)(x).reshape(
+                b, s, cfg.kv_heads, dh)
 
         impl = cfg.attention_impl
         ring = impl in ("auto", "ring") and mesh_axis_size("sequence") > 1
@@ -179,12 +199,20 @@ class Attention(nn.Module):
             cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
             cos2 = jnp.repeat(cos[:s], 2, axis=-1)
             sin2 = jnp.repeat(sin[:s], 2, axis=-1)
-            out = jnp.transpose(
-                flash_attention_rope(jnp.transpose(q, (0, 2, 1, 3)),
-                                     jnp.transpose(k, (0, 2, 1, 3)),
-                                     jnp.transpose(v, (0, 2, 1, 3)),
-                                     cos2, sin2, True),
-                (0, 2, 1, 3))
+            out_t = flash_attention_rope(jnp.transpose(q, (0, 2, 1, 3)),
+                                         jnp.transpose(k, (0, 2, 1, 3)),
+                                         jnp.transpose(v, (0, 2, 1, 3)),
+                                         cos2, sin2, True)
+            if cfg.fused_wo:
+                # Contract the kernel's head-major output against the
+                # (H, dh, D) view of wo directly — the explicit
+                # (B,H,S,D) -> (B,S,H*dh) relayout disappears into the
+                # matmul's own layout handling.
+                wo = _Kernel((nq, cfg.dim), cfg.param_dtype, name="wo")()
+                return jnp.einsum(
+                    "bhsd,hde->bse", out_t,
+                    wo.reshape(cfg.n_heads, dh, cfg.dim).astype(cfg.dtype))
+            out = jnp.transpose(out_t, (0, 2, 1, 3))
         elif (not ring and resolved == "pallas" and positions is None
                 and cfg.qkv_layout == "bhsd"):
             # Kernel-native layout path: transpose BEFORE rope so the rope

@@ -189,3 +189,32 @@ def test_rope_impl_fused_matches_xla_in_model():
         a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
         rel = np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-12)
         assert rel < 1e-4, rel
+
+
+def test_fused_wo_matches_dense_wo():
+    """cfg.fused_wo (default ON): contracting wo against the kernel's
+    head-major output equals transpose+reshape+Dense — same param tree,
+    same function (rope-fused pallas path, interpret mode)."""
+    toks = jnp.asarray(
+        np.random.default_rng(2).integers(0, 512, (2, 128)), jnp.int32)
+    kw = dict(attention_impl="pallas", rope_impl="fused")
+    m0 = Transformer(_tiny_fp32(fused_wo=False, **kw))
+    m1 = Transformer(_tiny_fp32(fused_wo=True, **kw))
+    p = m0.init(jax.random.PRNGKey(0), toks)["params"]
+    p1 = m1.init(jax.random.PRNGKey(0), toks)["params"]
+    assert (jax.tree_util.tree_structure(p)
+            == jax.tree_util.tree_structure(p1))
+    o0 = m0.apply({"params": p}, toks)
+    o1 = m1.apply({"params": p}, toks)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o0),
+                               rtol=1e-4, atol=1e-5)
+    g0 = jax.grad(lambda p: jnp.sum(jnp.sin(m0.apply({"params": p},
+                                                     toks))))(p)
+    g1 = jax.grad(lambda p: jnp.sum(jnp.sin(m1.apply({"params": p},
+                                                     toks))))(p)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        assert (np.linalg.norm(a - b)
+                / (np.linalg.norm(a) + 1e-12)) < 1e-4
