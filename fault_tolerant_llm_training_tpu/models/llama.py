@@ -142,25 +142,26 @@ class Attention(nn.Module):
         dense = dict(use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=_DENSE_INIT)
         b, s = x.shape[0], x.shape[1]
+        head_major = None  # (qt, kt, vt) in (B, H, S, D) when qkv_einsum
         if cfg.qkv_einsum:
             # Head-major projections: contract x against the (D, H, dh)
             # views so q/k/v land directly in the flash kernels'
             # (B, H, S, D) layout — no activation-side transpose between
             # projection and kernel (pairs with fused_wo on the output
-            # side). rope_impl='fused' path consumes these as-is; other
-            # paths transpose back below.
+            # side). The rope_impl='fused' branch below consumes
+            # head_major as-is; other paths transpose to the canonical
+            # (B, S, H, D).
             def proj(name, heads):
                 w = _Kernel((cfg.dim, heads * dh), cfg.param_dtype,
                             name=name)()
                 return jnp.einsum(
                     "bsd,dhe->bhse", x,
                     w.reshape(cfg.dim, heads, dh).astype(cfg.dtype))
-            qt = proj("wq", cfg.n_heads)
-            kt = proj("wk", cfg.kv_heads)
-            vt = proj("wv", cfg.kv_heads)
-            q = jnp.transpose(qt, (0, 2, 1, 3))
-            k = jnp.transpose(kt, (0, 2, 1, 3))
-            v = jnp.transpose(vt, (0, 2, 1, 3))
+            head_major = (proj("wq", cfg.n_heads), proj("wk", cfg.kv_heads),
+                          proj("wv", cfg.kv_heads))
+            q = jnp.transpose(head_major[0], (0, 2, 1, 3))
+            k = jnp.transpose(head_major[1], (0, 2, 1, 3))
+            v = jnp.transpose(head_major[2], (0, 2, 1, 3))
         elif cfg.fused_qkv:
             # One (D, (H+2K)*dh) matmul over the concatenated kernels:
             # x is read once instead of three times, and the backward's
@@ -199,9 +200,13 @@ class Attention(nn.Module):
             cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
             cos2 = jnp.repeat(cos[:s], 2, axis=-1)
             sin2 = jnp.repeat(sin[:s], 2, axis=-1)
-            out_t = flash_attention_rope(jnp.transpose(q, (0, 2, 1, 3)),
-                                         jnp.transpose(k, (0, 2, 1, 3)),
-                                         jnp.transpose(v, (0, 2, 1, 3)),
+            if head_major is not None:  # qkv_einsum: already (B, H, S, D)
+                qt_in, kt_in, vt_in = head_major
+            else:
+                qt_in = jnp.transpose(q, (0, 2, 1, 3))
+                kt_in = jnp.transpose(k, (0, 2, 1, 3))
+                vt_in = jnp.transpose(v, (0, 2, 1, 3))
+            out_t = flash_attention_rope(qt_in, kt_in, vt_in,
                                          cos2, sin2, True)
             if cfg.fused_wo:
                 # Contract the kernel's head-major output against the

@@ -144,7 +144,10 @@ def test_fused_projections_same_tree_and_function():
     p0 = m0.init(jax.random.PRNGKey(0), toks)["params"]
     ref = m0.apply({"params": p0}, toks)
     for kw in (dict(fused_w13=True), dict(fused_qkv=True),
-               dict(fused_w13=True, fused_qkv=True)):
+               dict(fused_w13=True, fused_qkv=True),
+               dict(qkv_einsum=True),
+               dict(qkv_einsum=True, attention_impl="pallas",
+                    rope_impl="fused")):
         m = Transformer(_tiny_fp32(**kw))
         p = m.init(jax.random.PRNGKey(0), toks)["params"]
         assert (jax.tree_util.tree_structure(p)
