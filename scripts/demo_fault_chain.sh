@@ -35,7 +35,14 @@ SLURM_JOB_ID=demo1 python train.py "${COMMON[@]}" --training-steps 100000 \
   --resubmit-command "touch $WORK/resubmitted" \
   > logs/output_demo1.out 2>&1 &
 PID=$!
-sleep 20          # let it train a while (compile + some hundreds of steps)
+# Anchor the signal on the training-start log line, NOT a fixed sleep: a
+# cold compile can outlast any constant, and USR1 before train.py's
+# handlers are registered kills the job with the default disposition.
+for _ in $(seq 1 120); do
+    grep -q "Starting training!" logs/output_demo1.out 2>/dev/null && break
+    sleep 2
+done
+sleep 10          # train a few hundred steps past the start
 kill -USR1 $PID   # what Slurm sends 120 s before the time limit
 wait $PID
 
