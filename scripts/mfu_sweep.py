@@ -79,7 +79,9 @@ def main():
         (fa.FWD_BLOCK_Q, fa.FWD_BLOCK_K, fa.DQ_BLOCK_Q, fa.DQ_BLOCK_K,
          fa.DKV_BLOCK_Q, fa.DKV_BLOCK_K) = map(int, args.tiles.split(","))
 
-    cfg = get_config(args.model, vocab_size=50257, seq_len=2048, **overrides)
+    base = dict(vocab_size=50257, seq_len=2048)
+    base.update(overrides)  # --set may override vocab_size/seq_len too
+    cfg = get_config(args.model, **base)
     mesh = make_mesh()
     with use_mesh(mesh):
         state, step_fn = synthetic_state_and_step(cfg, mesh=mesh)
