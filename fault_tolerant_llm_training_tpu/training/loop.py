@@ -291,10 +291,12 @@ class Trainer:
             if not cfg.eval_dataset:
                 logger.warning(
                     "--eval-frequency is set without --eval-dataset: "
-                    "'held-out' eval will run on the first %d training "
-                    "samples — exactly the ones the map loader trains on "
-                    "first, so eval loss can look optimistically low",
-                    cfg.batch_size * cfg.eval_batches)
+                    "'held-out' eval will run on the first %d corpus rows, "
+                    "which the training loader also trains on (%s), so "
+                    "eval loss can look optimistically low",
+                    cfg.batch_size * cfg.eval_batches,
+                    "at a shuffled position" if cfg.shuffle
+                    else "first, in the same order")
             eval_ds = ParquetDataset(
                 cfg.eval_dataset or cfg.dataset, self.tokenizer,
                 cfg.sequence_length, cfg.batch_size * cfg.eval_batches,

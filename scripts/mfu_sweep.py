@@ -37,6 +37,7 @@ def main():
                    help="TransformerConfig overrides (int/float/str coerced)")
     p.add_argument("--model", default="gpt2-125m")
     p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--passes", type=int, default=2)
     p.add_argument("--ce-block", type=int, default=None,
@@ -84,7 +85,8 @@ def main():
     cfg = get_config(args.model, **base)
     mesh = make_mesh()
     with use_mesh(mesh):
-        state, step_fn = synthetic_state_and_step(cfg, mesh=mesh)
+        state, step_fn = synthetic_state_and_step(cfg, mesh=mesh,
+                                                  grad_accum=args.grad_accum)
         toks, labels = synthetic_batch(
             cfg, args.batch_size, sharding=NamedSharding(mesh, batch_pspec()))
         for _ in range(5):
@@ -106,6 +108,8 @@ def main():
         tag += ",fused_ce"
     if args.tiles:
         tag += f",tiles={args.tiles}"
+    if args.grad_accum > 1:
+        tag += f",accum={args.grad_accum}"
     tps = args.batch_size * cfg.seq_len * args.steps / dt
     print(f"variant={tag} tokens_per_sec={tps:.0f} "
           f"ms_per_step={dt / args.steps * 1000:.2f} loss={loss:.4f}",
