@@ -109,17 +109,19 @@ class TransformerConfig:
     # Params stay scan-form/stacked (the 'pipe' sharding needs the
     # leading layer axis); only the stage body's control flow changes —
     # this is the PP analogue of layer_impl="loop", recovering the
-    # cross-layer fusion whose loss costs the scan trunk ~19% on TPU
-    # (BASELINE.md round 2). Measured 20% faster than the scanned stage
-    # body on the CPU mesh (scripts/pp_bench.py, BASELINE.md round 4)
-    # with bit-identical losses — but default OFF: the closest measured
-    # TPU datapoint for stacked-param slice unrolling (nn.scan(unroll=N),
-    # models/llama.py NOTE) REGRESSED 22% on chip, and --pp cannot be
-    # timed on this repo's single chip. The static-Python-loop form here
-    # avoids the in-scan slicing that datapoint blamed, so it may well
-    # win on TPU like the loop trunk does — opt in and A/B when real
-    # multi-chip hardware exists.
-    pp_stage_unroll: bool = False
+    # cross-layer fusion whose loss costs the scan trunk ~19-28% on TPU
+    # (BASELINE.md rounds 2/4). Default ON, on two measurements of the
+    # exact compute pattern: the static unroll over stacked params is
+    # 22.5% faster than the lax.scan form ON THE CHIP
+    # (scripts/stage_unroll_bench.py: 148.4 vs 191.5 ms fwd+bwd at the
+    # bench shape — distinct from the REJECTED nn.scan(unroll=N), whose
+    # in-scan dynamic param slicing regressed 22%) and 20% faster on the
+    # CPU mesh through the full 1F1B step (scripts/pp_bench.py), with
+    # bit-identical losses. The price is compile time proportional to
+    # layers-per-stage (L/P — already P-fold smaller than the loop
+    # trunk's); --no-pp-stage-unroll restores O(1)-compile scanning for
+    # very deep stages.
+    pp_stage_unroll: bool = True
     remat: bool = False
     # --- Mixture of Experts (models/moe.py; 0 experts = dense reference
     # FFN). Experts shard over the mesh's 'expert' axis (--ep). ---

@@ -201,11 +201,13 @@ def _stage_layers(block, cfg, stack_local, h, pos, collect_aux):
 
     Shared by the GPipe forward (pipeline_hidden) and the 1F1B tick loop
     (pipeline_value_and_grad) so their per-layer application can never
-    diverge. Control flow follows ``cfg.pp_stage_unroll``: a lax.scan
-    over the stacked params (O(1) compile in stage depth) or a static
-    Python unroll (cross-layer fusion back — measured tradeoffs in
-    configs.py). ``collect_aux`` accumulates the MoE routers' sown aux.
-    Returns (h_out, summed aux — 0.0 when not collecting)."""
+    diverge. Control flow follows ``cfg.pp_stage_unroll`` (default on):
+    a static Python unroll over ``tree[i]`` slices — measured 22.5%
+    faster than the lax.scan form on the chip and 20% through the full
+    1F1B step on the CPU mesh (configs.py) — or the lax.scan form
+    (O(1) compile in stage depth). ``collect_aux`` accumulates the MoE
+    routers' sown aux. Returns (h_out, summed aux — 0.0 when not
+    collecting)."""
     if cfg.pp_stage_unroll:
         aux = jnp.zeros((), jnp.float32)
         n_local = jax.tree_util.tree_leaves(stack_local)[0].shape[0]

@@ -52,7 +52,7 @@ class TrainConfig:
     pp: int = 1  # pipeline-parallel mesh size (needs --layer-impl scan)
     microbatches: int = 0  # pipeline microbatches (0 = one per stage)
     pp_schedule: str = "1f1b"  # 1f1b (O(pp) activation memory) | gpipe
-    pp_stage_unroll: bool = False  # unroll each stage's layer loop (models/configs.py)
+    pp_stage_unroll: bool = True  # unroll each stage's layer loop (models/configs.py)
     ep: int = 1  # expert-parallel mesh size (needs an MoE model)
     # MoE overrides; None = keep the model preset's values
     moe_experts: Optional[int] = None
@@ -167,12 +167,13 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="pipeline schedule: 1f1b interleaves each "
                              "microbatch's backward (O(pp) activation "
                              "memory); gpipe stores all microbatches")
-    parser.add_argument("--pp-stage-unroll", action="store_true",
-                        help="Unroll each pipeline stage's layer loop "
-                             "(static Python loop, params stay stacked): "
-                             "20%% faster on the CPU mesh, unmeasured on "
-                             "multi-chip TPU — see models/configs.py for "
-                             "why it is opt-in")
+    parser.add_argument("--no-pp-stage-unroll", dest="pp_stage_unroll",
+                        action="store_false",
+                        help="Scan (rather than unroll) each pipeline "
+                             "stage's layer loop: O(1) compile time in "
+                             "stage depth, ~22%% slower (the unrolled "
+                             "default's pattern measured on-chip, "
+                             "BASELINE.md round 4)")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel size (needs an MoE model, "
                              "e.g. --model tiny-moe or --moe-experts N)")
