@@ -1,0 +1,127 @@
+"""Prototype probe: flash FORWARD consuming (B, S, H*D) directly via
+two-head 128-lane blocks — can the q/k/v input-side transpose copies die?
+
+The round-4 state (ROUND_NOTES round-5 candidates): the last ~5 ms of the
+copy family is the (B,S,H,D)->(B,H,S,D) relayout feeding the kernels. A
+(1, block_q, dh=64) block on the UNtransposed (B, S, H*D) array is
+illegal (the trailing block dim must be a multiple of 128 or full), but a
+(1, block_q, 128) block covering TWO adjacent 64-wide heads is legal —
+at the cost of lane-half slicing inside the kernel and a doubled body.
+
+This probe times the forward only, at the bench shape, against the
+production path (transpose + resident fwd kernel). If the packed form
+does not clearly win here, the full-family surgery (5 kernels + GQA
+mapping + backward residual plumbing) is not worth it.
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+    from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
+
+    b, s, h, d = 8, 2048, 12, 64
+    if "--small" in sys.argv:  # CPU correctness shape
+        b, s, h, d = 1, 256, 4, 64
+    block_q, block_k = fa._blocks(s, fa.FWD_BLOCK_Q, fa.FWD_BLOCK_K)
+    scale = 1.0 / (d ** 0.5)
+
+    rng = np.random.default_rng(0)
+    q_flat = jnp.asarray(rng.standard_normal((b, s, h * d)), jnp.bfloat16)
+    k_flat = jnp.asarray(rng.standard_normal((b, s, h * d)), jnp.bfloat16)
+    v_flat = jnp.asarray(rng.standard_normal((b, s, h * d)), jnp.bfloat16)
+
+    # ---- production path: reshape+transpose, resident fwd kernel ----
+    def prod(qf, kf, vf):
+        qt = jnp.transpose(qf.reshape(b, s, h, d), (0, 2, 1, 3))
+        kt = jnp.transpose(kf.reshape(b, s, h, d), (0, 2, 1, 3))
+        vt = jnp.transpose(vf.reshape(b, s, h, d), (0, 2, 1, 3))
+        out, _ = fa._flash_fwd_t(qt, kt, vt, True, fa._interpret())
+        return out  # (B, H, S, D)
+
+    # ---- packed path: (B, S, H*D) with two-head 128-lane blocks ----
+    def packed_kernel(q_ref, k_ref, v_ref, o_ref):
+        # q_ref/o_ref: (1, block_q, 128) at (bi, qi, pair);
+        # k_ref/v_ref: (1, S, 128) at (bi, 0, pair). Two heads per step.
+        q_start = pl.program_id(1) * block_q
+        n_full, n_total = fa._k_block_bounds(q_start, block_q, s, block_k,
+                                             True)
+        o_halves = []
+        for half in (slice(0, d), slice(d, 2 * d)):
+            q2 = fa._prescale_q(q_ref[0, :, half], scale)
+
+            def body(j, carry, masked, half=half, q2=q2):
+                k_start = j * block_k
+                k = k_ref[0, pl.ds(k_start, block_k), half]
+                v = v_ref[0, pl.ds(k_start, block_k), half]
+                return fa._online_softmax_step(q2, k, v, carry, q_start,
+                                               k_start, masked)
+
+            init = (jnp.full((block_q,), fa.NEG_INF, jnp.float32),
+                    jnp.zeros((block_q,), jnp.float32),
+                    jnp.zeros((block_q, d), jnp.float32))
+            carry = jax.lax.fori_loop(
+                0, n_full, functools.partial(body, masked=False), init)
+            m, l, acc = jax.lax.fori_loop(
+                n_full, n_total, functools.partial(body, masked=True), carry)
+            o_halves.append((acc / l[:, None]).astype(o_ref.dtype))
+        o_ref[0] = jnp.concatenate(o_halves, axis=-1)
+
+    def packed(qf, kf, vf):
+        return pl.pallas_call(
+            packed_kernel,
+            grid=(b, s // block_q, h // 2),
+            in_specs=[
+                pl.BlockSpec((1, block_q, 128),
+                             lambda bi, qi, pi: (bi, qi, pi)),
+                pl.BlockSpec((1, s, 128), lambda bi, qi, pi: (bi, 0, pi)),
+                pl.BlockSpec((1, s, 128), lambda bi, qi, pi: (bi, 0, pi)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, 128),
+                                   lambda bi, qi, pi: (bi, qi, pi)),
+            out_shape=jax.ShapeDtypeStruct((b, s, h * d), qf.dtype),
+            interpret=fa._interpret(),
+        )(qf, kf, vf)
+
+    # correctness first
+    want = np.asarray(
+        jnp.transpose(prod(q_flat, k_flat, v_flat),
+                      (0, 2, 1, 3)).reshape(b, s, h * d), np.float32)
+    got = np.asarray(packed(q_flat, k_flat, v_flat), np.float32)
+    err = np.max(np.abs(got - want)) / (np.max(np.abs(want)) or 1.0)
+    print(f"packed-vs-production rel err: {err:.3e}", flush=True)
+    assert err < 2e-2, "packed kernel wrong"
+
+    def timed(fn, tag):
+        g = jax.jit(fn)
+        out = g(q_flat, k_flat, v_flat)
+        hard_sync(out)
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(30):
+                out = g(q_flat, k_flat, v_flat)
+            hard_sync(out)
+            best = min(best, (time.perf_counter() - t0) / 30)
+        print(f"{tag}: {best * 1000:.2f} ms", flush=True)
+        return best
+
+    t_prod = timed(prod, "transpose + resident fwd (production)")
+    t_pack = timed(packed, "packed two-head fwd on (B,S,H*D)     ")
+    print(f"packed/production ratio: {t_pack / t_prod:.3f}")
+
+
+if __name__ == "__main__":
+    main()
