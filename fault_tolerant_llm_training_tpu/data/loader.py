@@ -4,10 +4,18 @@
 Yields ``(inputs, labels)`` numpy batches; delegates position state to the
 underlying dataset so the loader itself is checkpointable. Device transfer /
 double buffering lives in ``prefetch.py``.
+
+``HostShardedDataLoader`` is the pod-scale map-path variant: each host
+tokenizes ONLY the global-batch rows its own devices consume (SURVEY.md §7.3
+hard part 5 — the replicated loader does O(hosts) redundant tokenization on
+exactly the path the survey names as the pod bottleneck), while the
+checkpointed position stays the single GLOBAL sample index, so data state is
+host-count-agnostic and cross-topology resume is unchanged.
 """
 
 from typing import Dict, Iterator, Tuple
 
+import jax
 import numpy as np
 
 from .collator import CollatorForCLM
@@ -49,3 +57,76 @@ class DataLoader:
     def set_state(self, state: Dict) -> None:
         self.dataset.set_state(state)
         self.resume()
+
+
+class HostShardedDataLoader(DataLoader):
+    """Map-path loader that materializes only this host's batch rows.
+
+    The row set is derived exactly from the batch ``NamedSharding``'s
+    device→index map (no contiguity or host-layout assumption): the union
+    of the batch-dim slices of this process's addressable devices. With N
+    hosts each tokenizes ~B/N rows instead of all B. ``stage_global``
+    assembles the global (B, S) array from per-device shards
+    (``jax.make_array_from_single_device_arrays``) — the replicated
+    ``device_put``-the-whole-batch path stays available as
+    ``--data-sharding replicated``.
+
+    Correctness contract: the sample at global batch row ``b`` of the batch
+    starting at global position ``base`` is ``dataset[base + b]`` — the
+    same element the replicated loader's sequential ``next()`` walk hands
+    to row ``b`` — so the training trajectory is bit-identical to the
+    replicated path (asserted by tests/test_sharded_data.py). Shuffle and
+    wraparound live in ``dataset.__getitem__`` and apply unchanged; the
+    position advances by the full global batch size regardless of host
+    count.
+    """
+
+    def __init__(self, dataset: ParquetDataset, batch_size: int,
+                 collator: CollatorForCLM, sharding,
+                 sequence_length: int):
+        super().__init__(dataset, batch_size, collator)
+        self.sharding = sharding
+        self._shape = (batch_size, sequence_length)
+        proc = jax.process_index()
+        self._dev_slices = [
+            (d, idx)
+            for d, idx in sharding.devices_indices_map(self._shape).items()
+            if d.process_index == proc
+        ]
+        rows = set()
+        for _, (idx_b, _) in self._dev_slices:
+            rows.update(range(idx_b.start or 0,
+                              batch_size if idx_b.stop is None else idx_b.stop))
+        self.host_rows = np.asarray(sorted(rows), dtype=np.int64)
+        self.rows_tokenized = 0  # diagnostic: disjointness is tested on this
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def resume(self) -> None:
+        pass  # position lives in the dataset; nothing to rebind
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        base = self.dataset._next_index
+        if base + self.batch_size > len(self.dataset):
+            raise StopIteration
+        examples = [self.dataset[base + int(b)] for b in self.host_rows]
+        self.dataset._next_index = base + self.batch_size  # GLOBAL advance
+        self.rows_tokenized += len(examples)
+        return self.collator(examples)
+
+    def stage_global(self, inputs: np.ndarray, labels: np.ndarray):
+        """(host_rows, S) local arrays -> global (B, S) jax.Arrays on this
+        host's devices, sharded per ``self.sharding``."""
+        out = []
+        for arr in (inputs, labels):
+            shards = []
+            for d, (idx_b, idx_s) in self._dev_slices:
+                lo = int(np.searchsorted(self.host_rows, idx_b.start or 0))
+                hi = int(np.searchsorted(
+                    self.host_rows,
+                    self._shape[0] if idx_b.stop is None else idx_b.stop))
+                shards.append(jax.device_put(arr[lo:hi, idx_s], d))
+            out.append(jax.make_array_from_single_device_arrays(
+                self._shape, self.sharding, shards))
+        return out[0], out[1]

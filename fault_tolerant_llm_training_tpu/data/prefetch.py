@@ -54,6 +54,14 @@ class DevicePrefetcher:
             return jax.device_put(arr, self.sharding)
         return jax.device_put(np.asarray(arr))
 
+    def _stage_pair(self, inputs: np.ndarray, labels: np.ndarray):
+        """Host-sharded loaders carry only this host's rows and assemble
+        the global array themselves (loader.stage_global); replicated
+        loaders device_put the full batch against the global sharding."""
+        if hasattr(self.loader, "stage_global"):
+            return self.loader.stage_global(inputs, labels)
+        return self._stage(inputs), self._stage(labels)
+
     def _worker(self):
         try:
             while not self._stop.is_set():
@@ -63,7 +71,7 @@ class DevicePrefetcher:
                     break
                 state = self.loader.get_state()
                 if self.stage_in_worker:
-                    inputs, labels = self._stage(inputs), self._stage(labels)
+                    inputs, labels = self._stage_pair(inputs, labels)
                 self._q.put((inputs, labels, state))
         except BaseException as e:  # surfaced to the consumer
             self._exc = e
@@ -88,7 +96,8 @@ class DevicePrefetcher:
             raise StopIteration
         if not self.stage_in_worker:
             inputs, labels, state = item
-            return self._stage(inputs), self._stage(labels), state
+            inputs, labels = self._stage_pair(inputs, labels)
+            return inputs, labels, state
         return item
 
     def stop(self):

@@ -83,18 +83,23 @@ def handle_exit(trainer, error_type: int, logger) -> None:
             # Coordination: signal exits were agreed cluster-wide
             # (ft/signals.py synced check), and deterministic code errors
             # (injection, non-finite grads) hit every host at the same step.
-            # An error of unknown provenance may be host-local: on a pod the
-            # other hosts are still stepping, so a coordinated (barrier +
-            # collective Orbax write) save would hang — skip it there.
+            # An error of unknown provenance may be host-local: a unilateral
+            # coordinated (barrier + collective Orbax write) save would
+            # hang, so on a pod those first run the fault fence
+            # (ft/multihost.py): every host — the erroring one announced as
+            # it unwound, the others raised PeerHostError off their
+            # per-dispatch poll — converges on the cluster-maximum
+            # dispatched step, after which the coordinated save is safe and
+            # every host saves the SAME step. The fence does not return
+            # when a peer is dead: the degraded path exits 0 without a
+            # checkpoint rather than hanging the survivors.
             coordinated = (error_type == SIGNAL_TIMEOUT
                            or getattr(trainer, "error_is_replicated", False))
-            if coordinated or jax.process_count() == 1:
-                saved_step = trainer.save_checkpoint(wait=True,
-                                                     coordinated=coordinated)
-                logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
-            else:
-                logger.info("[EXIT HANDLER] Host-local error on a multi-host "
-                            "run: cannot write a coordinated checkpoint.")
+            if not coordinated and jax.process_count() > 1:
+                coordinated = trainer.coordinate_local_error()
+            saved_step = trainer.save_checkpoint(wait=True,
+                                                 coordinated=coordinated)
+            logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
         else:
             logger.info("[EXIT HANDLER] No training state to save yet.")
         if error_type == SIGNAL_TIMEOUT:

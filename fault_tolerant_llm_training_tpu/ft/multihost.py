@@ -20,15 +20,66 @@ Signal-combination policy: USR1 (timeout pre-warning, save + requeue) wins
 over TERM (cancel, no save) when hosts disagree mid-grace-period — the
 Slurm timeout chain delivers USR1 first, so a mixed view means a preemption
 is in progress and losing the checkpoint would be the worse failure.
+
+Host-local (non-replicated) faults — the pod fault fence
+--------------------------------------------------------
+The reference's −1 path always saves (ref: utils.py:69-81). On a pod a
+*host-local* error (one process's data loader dies, a local OSError, ...)
+cannot simply enter the coordinated save: the other hosts are still
+stepping and would never reach the pre-save barrier, while the erroring
+host's silence strands THEM inside their next device collective. The fence
+closes both holes using the jax.distributed KV store — a host-side gRPC
+channel that involves no device collectives, so it can be used at any
+moment without draining the dispatch pipeline:
+
+1. the erroring host publishes ``ftl_fault/err/<proc>`` as the exception
+   unwinds (``announce_local_error``);
+2. every host polls that prefix (non-blocking) before each dispatch and
+   raises ``PeerHostError`` — routed through the same −1 exit policy —
+   when any peer has announced;
+3. in the exit handler, all hosts run the *fence*: publish their own
+   last-dispatched step, gather everyone's (bounded by a watchdog),
+   dispatch real catch-up steps to the cluster maximum, and only then run
+   the ordinary coordinated save — every host saves the SAME step;
+4. every blocking multihost wait (metric consume, signal-boundary
+   allgather, stop-gather, pre-save barrier) is wrapped in ``watchdog``:
+   if it times out and no peer error is pending, the peer is presumed dead
+   (SIGKILL, kernel panic) and the survivor degrades to a clean no-save
+   ``exit 0`` (``die_uncoordinated``) instead of hanging until the
+   scheduler shoots it.
 """
 
+import os
 import signal
-from typing import Iterable, Optional
+import threading
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import jax
 
 _USR1 = int(signal.SIGUSR1)  # 10: save + requeue
 _TERM = int(signal.SIGTERM)  # 15: no save
+
+# KV-store namespace for the fault fence (one incident per process lifetime:
+# after a fence the job exits, so keys never need generation counters).
+_ERR_PREFIX = "ftl_fault/err/"
+_STOP_PREFIX = "ftl_fault/stop/"
+_DEAD_PREFIX = "ftl_fault/dead/"
+
+# Audit line for the degraded (dead-peer) exit; tests and operators grep it.
+AUDIT_UNCOORDINATED_FMT = ("[EXIT HANDLER] Pod fault fence failed ({reason}); "
+                           "terminating without a checkpoint.")
+
+
+class PeerHostError(Exception):
+    """Raised between dispatches when another host announced a local fault.
+
+    ``args == ("Exception", -1)`` so the exit-policy classification
+    (ft/handler.py ``classify_exception``) routes it down the reference's
+    −1 path: save (coordinated, via the fence) and do NOT resubmit.
+    """
+
+    def __init__(self):
+        super().__init__("Exception", -1)
 
 
 def combine_signals(signums: Iterable[int]) -> Optional[int]:
@@ -74,3 +125,155 @@ def is_coordinator() -> bool:
 def should_resubmit() -> bool:
     """Exactly one host chains the next Slurm job (ref: utils.py:84)."""
     return is_coordinator()
+
+
+# --------------------------------------------------------------- fault fence
+def _kv():
+    """The jax.distributed KV client, or None (single-process runs)."""
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def _kv_set(prefix: str, value: str) -> None:
+    """Best-effort keyed publish under this process's index: a dead KV
+    connection must never mask the fault being reported."""
+    client = _kv()
+    if client is None:
+        return
+    try:
+        client.key_value_set(f"{prefix}{jax.process_index()}", value)
+    except Exception:
+        pass
+
+
+def announce_local_error(dispatched_step: int) -> None:
+    """Publish this host's local fault so peers stop dispatching.
+
+    Called as the exception unwinds (training/loop.py ``run``) — BEFORE the
+    exit handler — so the peers' per-dispatch poll sees it within one
+    iteration and the dispatch skew stays bounded.
+    """
+    _kv_set(_ERR_PREFIX, str(int(dispatched_step)))
+
+
+def peer_error_pending() -> bool:
+    """Non-blocking: has ANY host (possibly this one) announced a fault?"""
+    client = _kv()
+    if client is None:
+        return False
+    try:
+        return bool(client.key_value_dir_get(_ERR_PREFIX))
+    except Exception:
+        return False
+
+
+def publish_stop(dispatched_step: int) -> None:
+    """Publish this host's last-dispatched step count for the fence."""
+    _kv_set(_STOP_PREFIX, str(int(dispatched_step)))
+
+
+def gather_stops(timeout_seconds: float) -> Optional[Dict[int, int]]:
+    """Collect every host's published stop step; None if a peer never
+    publishes within the timeout (it died before reaching its fence)."""
+    client = _kv()
+    if client is None:
+        return None
+    stops: Dict[int, int] = {}
+    for p in range(jax.process_count()):
+        try:
+            val = client.blocking_key_value_get(
+                f"{_STOP_PREFIX}{p}", int(timeout_seconds * 1000))
+        except Exception:
+            return None
+        stops[p] = int(val)
+    return stops
+
+
+def publish_dead() -> None:
+    """Mark this host unable to reach the agreed step (fence catch-up
+    failed). The fence's drain watchdog polls this (``watchdog(...,
+    poll=peer_dead_pending)``) and degrades within the poll interval
+    instead of waiting the full timeout for steps that will never
+    execute."""
+    _kv_set(_DEAD_PREFIX, "1")
+
+
+def peer_dead_pending() -> bool:
+    client = _kv()
+    if client is None:
+        return False
+    try:
+        return bool(client.key_value_dir_get(_DEAD_PREFIX))
+    except Exception:
+        return False
+
+
+def watchdog(fn: Callable, timeout_seconds: float,
+             poll: Optional[Callable[[], bool]] = None,
+             poll_seconds: float = 2.0) -> Tuple[bool, object]:
+    """Run a blocking wait with a bound: ``(True, result)`` on completion,
+    ``(False, None)`` on timeout (or when ``poll()`` turns true first —
+    e.g. a peer declaring itself dead, so the caller degrades within the
+    poll interval instead of burning the whole timeout).
+
+    ``fn(cancelled)`` receives a ``threading.Event`` that is SET before
+    the watchdog gives up. A pure wait (``np.asarray``) may ignore it —
+    an abandoned thread that merely finishes waiting is harmless. A
+    COMPOUND wait (drain loop + collective) MUST check it between phases
+    and go silent once set: an abandoned thread that wakes later (the
+    fence's catch-up completes the very steps it was blocked on) and then
+    issues a fresh device collective would interleave with the fence's
+    own collectives in different orders on different hosts — the exact
+    cross-thread hazard data/prefetch.py documents. The wait runs in a
+    daemon thread while the caller blocks in ``join`` — strictly
+    sequential until abandonment. Exceptions from ``fn`` are re-raised
+    here, in the calling thread; after abandonment they are discarded.
+    """
+    import time as _time
+
+    box: list = [None, None]  # [result, exception]
+    cancelled = threading.Event()
+
+    def _run():
+        try:
+            box[0] = fn(cancelled)
+        except BaseException as e:  # re-raised below, in the caller
+            box[1] = e
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    deadline = _time.monotonic() + timeout_seconds
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            break
+        t.join(min(poll_seconds, remaining) if poll else remaining)
+        if not t.is_alive():
+            break
+        if poll is not None and poll():
+            break
+    if t.is_alive():
+        cancelled.set()
+        return False, None
+    if box[1] is not None:
+        raise box[1]
+    return True, box[0]
+
+
+def die_uncoordinated(logger, reason: str) -> None:
+    """Degraded exit for a dead peer: no checkpoint is writable (a
+    coordinated save needs every host; this host's own state may be
+    donated into a hung computation), so log the audit line, flush, and
+    ``os._exit(0)`` — exit 0 keeps the Slurm never-mark-failed contract
+    (ref: train.py:119,129), and skipping teardown avoids joining runtime
+    threads that are wedged in a dead collective. No resubmit: −1
+    semantics (a chained job would meet the same dead node)."""
+    import logging
+
+    try:
+        logger.info(AUDIT_UNCOORDINATED_FMT.format(reason=reason))
+        logging.shutdown()  # flush the pipe before the hard exit
+    except Exception:
+        pass
+    os._exit(0)

