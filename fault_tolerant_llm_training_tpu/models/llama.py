@@ -159,9 +159,11 @@ class Attention(nn.Module):
                     w.reshape(cfg.dim, heads, dh).astype(cfg.dtype))
             head_major = (proj("wq", cfg.n_heads), proj("wk", cfg.kv_heads),
                           proj("wv", cfg.kv_heads))
-            q = jnp.transpose(head_major[0], (0, 2, 1, 3))
-            k = jnp.transpose(head_major[1], (0, 2, 1, 3))
-            v = jnp.transpose(head_major[2], (0, 2, 1, 3))
+            # Canonical (B, S, H, D) views are built lazily in the branches
+            # that consume them (ADVICE r4: materializing them here made
+            # the fused branch's correctness depend on XLA DCE, and a
+            # later accidental use would silently double-compute).
+            q = k = v = None
         elif cfg.fused_qkv:
             # One (D, (H+2K)*dh) matmul over the concatenated kernels:
             # x is read once instead of three times, and the backward's
@@ -227,9 +229,15 @@ class Attention(nn.Module):
             # 11.5 ms/step copy family in the BASELINE.md profile).
             from ..ops.flash_attention import flash_attention_bhsd
             cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
-            qt = apply_rope_bhsd(jnp.transpose(q, (0, 2, 1, 3)), cos, sin)
-            kt = apply_rope_bhsd(jnp.transpose(k, (0, 2, 1, 3)), cos, sin)
-            vt = jnp.transpose(v, (0, 2, 1, 3))
+            if head_major is not None:  # qkv_einsum: already (B, H, S, D)
+                qh, kh, vh = head_major
+            else:
+                qh = jnp.transpose(q, (0, 2, 1, 3))
+                kh = jnp.transpose(k, (0, 2, 1, 3))
+                vh = jnp.transpose(v, (0, 2, 1, 3))
+            qt = apply_rope_bhsd(qh, cos, sin)
+            kt = apply_rope_bhsd(kh, cos, sin)
+            vt = vh
             out = jnp.transpose(flash_attention_bhsd(qt, kt, vt, True),
                                 (0, 2, 1, 3))
         else:
@@ -238,6 +246,10 @@ class Attention(nn.Module):
             # outer product (sharded with the activations) rather than a
             # table gather, which the SPMD partitioner can only reshard by
             # full rematerialization.
+            if head_major is not None:  # qkv_einsum fell through to here
+                q = jnp.transpose(head_major[0], (0, 2, 1, 3))
+                k = jnp.transpose(head_major[1], (0, 2, 1, 3))
+                v = jnp.transpose(head_major[2], (0, 2, 1, 3))
             if positions is None:
                 cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
             else:

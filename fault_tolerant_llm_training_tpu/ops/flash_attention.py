@@ -183,9 +183,12 @@ def _rope_rot(x, c, s, scale_const=None):
     ``c``/``s`` are (rows, D) fp32 interleave-duplicated tables
     (``c[r, 2j] == c[r, 2j+1] == cos(angle_j(r))``). With ``scale_const``
     the softmax prescale (scale * log2(e), see _prescale_q) is folded in.
-    Rounds back to ``x.dtype`` — the same rounding point as the XLA-side
-    ``apply_rope`` + ``_prescale_q`` chain, so backward recomputation of
-    ``exp2(s - lse)`` stays exact."""
+    Rounds back to ``x.dtype`` ONCE at the end; the XLA-side chain rounds
+    twice on q (``apply_rope`` -> dtype, then ``_prescale_q`` -> dtype),
+    so under bf16 the two paths can differ by that one extra rounding —
+    fp32 is bit-identical (ADVICE r4). Within THIS path the forward and
+    backward recompute the rotation identically, so ``exp2(s - lse)``
+    stays exact regardless."""
     xf = x.astype(jnp.float32)
     xj = jax.lax.dot_general(xf, _rope_j(x.shape[-1]), (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -1092,11 +1095,12 @@ def flash_attention_rope(q, k, v, cos2, sin2, causal=True):
     custom-call boundary (~11 ms/step at the bench shape, BASELINE.md
     round-4 profile).
 
-    Numerics: the rotation runs in fp32 and rounds to the input dtype at
-    exactly the same point as the ``apply_rope`` + kernel chain; scores,
-    lse and the probability recomputation are bit-compatible with the
-    non-fused kernels fed pre-rotated inputs (tested in
-    tests/test_flash_attention.py)."""
+    Numerics: the rotation runs in fp32 with a single rounding to the
+    input dtype. In fp32 (where astype is a no-op) scores, lse and the
+    probability recomputation are bit-identical to the non-fused kernels
+    fed pre-rotated inputs (tested in tests/test_flash_attention.py);
+    under bf16 the q side agrees to one rounding — the fused path rounds
+    once where the XLA rope + prescale chain rounds twice (ADVICE r4)."""
     out, _ = _flash_fwd_t(q, k, v, causal, _interpret(), (cos2, sin2))
     return out
 

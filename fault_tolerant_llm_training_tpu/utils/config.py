@@ -76,6 +76,10 @@ class TrainConfig:
     # builds the full global batch; auto = host on pods, replicated alone.
     data_sharding: str = "auto"
     shuffle: bool = False  # seeded per-epoch shuffle (default: reference's strict doc order)
+    # exact = np.permutation per epoch (O(corpus) memory per host);
+    # feistel = keyed bijection computed per sample (O(1) memory — the
+    # pod-scale form; resume state is identical in shape either way)
+    shuffle_impl: str = "exact"
     pretokenize_dir: str = ""  # cache dir for one-time tokenization (map path)
     legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
     checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
@@ -245,6 +249,13 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                              "preserved (the reference trains in strict "
                              "document order, which produces order "
                              "artifacts in multi-epoch runs)")
+    parser.add_argument("--shuffle-impl", type=str, default="exact",
+                        choices=["exact", "feistel"],
+                        help="exact: np.permutation per epoch (O(corpus) "
+                             "host memory); feistel: keyed 4-round Feistel "
+                             "bijection per sample (O(1) memory, the "
+                             "pod-scale form; each row still appears "
+                             "exactly once per epoch)")
     parser.add_argument("--pretokenize-dir", type=str, default="",
                         help="Tokenize the corpus once into a memmap cache "
                              "here; steady-state loading becomes a row "

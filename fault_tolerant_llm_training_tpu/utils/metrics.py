@@ -33,9 +33,16 @@ class Throughput:
 
 
 def transformer_flops_per_token(n_params: int, seq_len: int, dim: int,
-                                n_layers: int) -> float:
-    """~6N per token for fwd+bwd, plus attention score FLOPs (12*L*S*d per token)."""
-    return 6.0 * n_params + 12.0 * n_layers * dim * seq_len
+                                n_layers: int, causal: bool = False) -> float:
+    """Model FLOPs per token, fwd+bwd: 6N matmul FLOPs plus attention
+    score/value FLOPs — 12*L*S*d per token dense, halved under a causal
+    mask (the kernels only compute the lower triangle). ``n_params``
+    should EXCLUDE the input-embedding table when the embedding is a
+    gather (no matmul FLOPs); the LM head does real matmuls and counts.
+    This causal-masked, embed-excluded convention is the one behind every
+    MFU figure in BASELINE.md."""
+    attn = 12.0 * n_layers * dim * seq_len
+    return 6.0 * n_params + (attn / 2.0 if causal else attn)
 
 
 def mfu(tokens_per_sec: float, flops_per_token: float, peak_flops: float) -> float:

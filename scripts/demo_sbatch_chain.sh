@@ -19,7 +19,7 @@
 # Asserts: saved step == resumed step (zero loss), the timeout/requeue/
 # cancel audit strings, and both jobs logged under the #SBATCH
 # --output=%j pattern. The only train.sh accommodation is the
-# env-overridable TRAINING_CMD (its default stays the reference shape) —
+# env-overridable FTL_TRAINING_CMD_OVERRIDE (its default stays the reference shape) —
 # the contract rides unchanged onto a real cluster. CPU, ~2-3 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -40,11 +40,12 @@ export FAKE_SLURM_DIR="$WORKDIR/.slurm"
 # Seconds of training before the shim's USR1 (anchored on the job's
 # "Starting training!" line, so compile time cannot race the handlers).
 export FAKE_SLURM_USR1_AFTER=${FAKE_SLURM_USR1_AFTER:-20}
-# Small config via train.sh's env override; no --raise-error — the
-# shim's USR1 IS the fault. The huge step target guarantees job A is
-# mid-training when the signal lands; job B inherits it and is
-# scancelled once its resume is verified (see header).
-export TRAINING_CMD=" --model tiny --tokenizer-name-or-path byte \
+# Small config via train.sh's namespaced env override (ADVICE r4:
+# FTL_TRAINING_CMD_OVERRIDE, collision-proof under sbatch --export=ALL);
+# no --raise-error — the shim's USR1 IS the fault. The huge step target
+# guarantees job A is mid-training when the signal lands; job B inherits
+# it and is scancelled once its resume is verified (see header).
+export FTL_TRAINING_CMD_OVERRIDE=" --model tiny --tokenizer-name-or-path byte \
   --sequence-length 128 --batch-size 2 --training-steps 100000 \
   --logging-frequency 50"
 

@@ -25,7 +25,12 @@
 # Overridable from the environment so a scheduler-shim harness
 # (scripts/demo_sbatch_chain.sh) can drive THIS script with a small
 # config; the default below is the reference's own shape with fault
-# injection ON (ref: train.sh:21-22).
+# injection ON (ref: train.sh:21-22). The override variable is
+# namespaced (ADVICE r4): sbatch defaults to --export=ALL, so a generic
+# name like TRAINING_CMD lying around an operator's shell would silently
+# replace the flagship config; FTL_TRAINING_CMD_OVERRIDE cannot collide
+# by accident.
+TRAINING_CMD="${FTL_TRAINING_CMD_OVERRIDE:-}"
 if [ -z "${TRAINING_CMD:-}" ]; then
 TRAINING_CMD=" --model gpt2-125m \
                --sequence-length 2048 \
