@@ -32,15 +32,19 @@ VPU economy (attention at head_dim 64 is VPU-bound on TPU, not MXU-bound):
   Backward accumulators run unscaled and are rescaled once per tile at the
   final write (exact: the accumulation is linear).
 
-lse is carried as (B, H, 1, S) — the q positions on the LANE dim. The
-Pallas TPU lowering requires a block's last two dims to be
-(8k, 128m)-tileable or full, and the TPU (8, 128) tile pads whatever
-lands on the trailing dims: a (B, H, S, 1) residual pads its singleton
-lane 128x (measured 95.25 MB per layer at the bench shape, seen in HBM
-dumps), where (1, S) pads the singleton sublane only 8x. Kernels read the
-(1, block_q) row and transpose it to the (block_q, 1) orientation the
-tile math uses — once per q tile (cached in scratch where the k loop is
-the grid). delta (rowwise dO . O) is computed inside the backward kernels
+lse is carried padding-free in both families (see _lse_layout): the
+streaming family as (B, H, 1, S) — q positions on the LANE dim — and the
+resident family as (B, H, S/128, 128) — the lse vector wrapped into full
+(8, 128) tiles. The Pallas TPU lowering requires a block's last two dims
+to be (8k, 128m)-tileable or full, and the TPU (8, 128) tile pads
+whatever lands on the trailing dims: the legacy (B, H, S, 1) residual
+(kept for unaligned shapes) pads its singleton lane 128x (measured
+95.25 MB per layer at the bench shape, seen in HBM dumps), where (1, S)
+pads the singleton sublane only 8x and (S/128, 128) pads nothing.
+Kernels read the (1, block_q) row / (block_q/128, 128) block and restore
+the (block_q, 1) orientation the tile math uses — once per q tile
+(cached in scratch where the k loop is the grid).
+delta (rowwise dO . O) is computed inside the backward kernels
 from the do/o tiles (see _delta) — an XLA-side delta materializes fp32
 casts of the full dO and O with layout-change copies at the custom-call
 boundary.
@@ -113,7 +117,13 @@ STREAM_THRESHOLD = 2048
 # double-buffered q-side tiles, all linear in S*D: calibrated at D=64,
 # S=8192 measured 21.0M > the 16M scoped limit while S=4096 fits, so the
 # dispatch bound is S*D <= 4096*64 (a D=128 model hits the same wall at
-# half the S). Within the bound but past STREAM_THRESHOLD, the forward
+# half the S). Round-5 on-chip validation (scripts/kernel_checks.py):
+# the bound holds WITH in-kernel rope at both boundary shapes — S=4096/
+# D=64 and S=2048/D=128 compile and match XLA (the rope path's extra
+# (S, D) rotated-K scratch fits; no derate needed, ADVICE r4). The D=64
+# tile constants also transfer to D=128 unchanged: a 10-combo resident
+# fwd/dq/dkv sweep at S=2048/D=128 (scripts/d128_tile_sweep.py) put the
+# defaults first, every variant 8-11% slower. Within the bound but past STREAM_THRESHOLD, the forward
 # streams while the backward runs fused (one softmax-core pass instead
 # of two).
 #
@@ -271,31 +281,46 @@ def _active_tiles(s: int):
             (DKV_BLOCK_Q, DKV_BLOCK_K))
 
 
-def _lse_layout(s: int) -> bool:
-    """Whether to carry lse packed as (B, H, 1, S) instead of the legacy
-    (B, H, S, 1) whose singleton lane the TPU tile pads 128x.
+def _lse_layout(s: int) -> str:
+    """The lse residual's memory layout at sequence length ``s``:
 
-    Packed only when the FORWARD streams (s > STREAM_THRESHOLD), where
-    the padding is the point — e.g. 384 MB of padding at S=64k — and only
-    when every q-tile is 128-lane aligned (odd sequence lengths degrade
-    tiles below 128 rows, making the packed blocks illegal). Consumers
-    (all via _read_lse): the streaming backward kernels, and the FUSED
-    resident backward when it runs past the forward's threshold (see
-    RESIDENT_BWD_SD_BUDGET — one entry transpose per grid step). At
-    s <= STREAM_THRESHOLD everything stays legacy: packing the resident
-    emit was measured and rejected twice (round 2 naive: −3%; round 3,
-    four variants incl. fully transposed tile math: −1.4 to −2.7% — the
-    transposed contraction forms cost more than the ~1 GB of padding
-    buys, which is nothing at either batch size; BASELINE.md)."""
-    return (s > STREAM_THRESHOLD
+    - ``"packed"`` — (B, H, 1, S), q positions on the lane dim. Streaming
+      family (s > STREAM_THRESHOLD), where the legacy layout's padding is
+      the point — e.g. 384 MB at S=64k — and every q-tile is 128-aligned
+      (odd sequence lengths degrade tiles below 128 rows, making the
+      packed blocks illegal). Consumers (via _read_lse): the streaming
+      backward kernels, and the FUSED resident backward when it runs past
+      the forward's threshold (RESIDENT_BWD_SD_BUDGET) — one entry
+      transpose per grid step.
+    - ``"blocked"`` — (B, H, S/128, 128): the resident family's packed
+      form (VERDICT r4 weak #3, the one variant the r2/r3 rejection
+      sweeps never built). The forward's (block_q,) lse vector wraps to
+      (block_q/128, 128) — a lane-preserving reshape, unlike the r3
+      relayout/transpose variants (−1.4 to −3%) — and the fused backward
+      unwraps it once per q-tile. Zero padding: the fp32 (S/128, 128)
+      plane tiles natively. Requires s and the resident q-tiles to be
+      128-multiples; FTL_LSE_RESIDENT=legacy opts out (A/B knob).
+    - ``"legacy"`` — (B, H, S, 1), whose singleton lane pads 128x
+      (~1.1 GB at the bs-8 bench shape). Kept for unaligned shapes.
+    """
+    if (s > STREAM_THRESHOLD
             and all(_fit_block(s, bq) % 128 == 0
-                    for bq, _ in _active_tiles(s)))
+                    for bq, _ in _active_tiles(s))):
+        return "packed"
+    if (s <= STREAM_THRESHOLD and s % 128 == 0
+            and os.environ.get("FTL_LSE_RESIDENT", "blocked") != "legacy"
+            and all(_fit_block(s, bq) % 128 == 0
+                    for bq, _ in _active_tiles(s))):
+        return "blocked"
+    return "legacy"
 
 
-def _read_lse(ref, g, packed):
-    """(block_q, 1) column lse from a kernel ref in either layout; ``g``
-    is the GQA group row (0 for per-head refs)."""
-    if packed:
+def _read_lse(ref, g, layout):
+    """(block_q, 1) column lse from a kernel ref; ``g`` is the GQA group
+    row (0 for per-head refs). Streaming-family layouts only — the
+    resident "blocked" plane is unwrapped inline in _bwd_fused_kernel
+    (the read needs the grid's q-tile index)."""
+    if layout == "packed":
         return jnp.transpose(ref[0, g])  # (1, bq) -> (bq, 1)
     return ref[0, g]
 
@@ -363,10 +388,12 @@ def _k_block_bounds(q_start, block_q, s_k, block_k, causal):
 
 
 def _fwd_kernel(*refs, block_k: int, scale: float, causal: bool,
-                rope: bool = False, group: int = 1):
+                rope: bool = False, group: int = 1,
+                lse_blocked: bool = False):
     # q_ref/o_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, S, D);
-    # lse_ref: (1, 1, block_q, 1) — the resident family is always legacy
-    # layout (_lse_layout packs the streaming family only).
+    # lse_ref: (1, 1, block_q/128, 128) in the blocked layout (the
+    # resident default — the (block_q,) lse vector wraps lane-preserving,
+    # see _lse_layout), else (1, 1, block_q, 1) legacy.
     # rope=True adds (cq, sq) q-row and (ck, sk) full-row table refs plus a
     # (S, D) scratch holding this KV head's rotated K (computed once per
     # GQA span — see _rope_rot; q is rotated per tile with the softmax
@@ -408,11 +435,20 @@ def _fwd_kernel(*refs, block_k: int, scale: float, causal: bool,
     m, l, acc = jax.lax.fori_loop(
         n_full, n_total, functools.partial(body, masked=causal), carry)
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log2(l))[:, None]  # base-2, internal only
+    lse = m + jnp.log2(l)  # base-2, internal only
+    if lse_blocked:
+        # Full (S/128, 128) plane revisited across q-tiles (Mosaic wants
+        # block dims 8/128-divisible or full; block_q/128 rows is neither
+        # at the production tiles) — each tile stores its wrapped rows.
+        rows = block_q // 128
+        lse_ref[0, 0, pl.ds(pl.program_id(2) * rows, rows), :] = (
+            lse.reshape(rows, 128))
+    else:
+        lse_ref[0, 0] = lse[:, None]
 
 
 def _bwd_fused_kernel(*refs, block_k: int, scale: float, causal: bool,
-                      group: int, packed: bool, rope: bool = False):
+                      group: int, lse_layout: str, rope: bool = False):
     """Fused resident backward: dq, dk and dv from ONE pass over the score
     tiles.
 
@@ -460,10 +496,21 @@ def _bwd_fused_kernel(*refs, block_k: int, scale: float, causal: bool,
     else:
         q2 = _prescale_q(q_ref[0, 0], scale)
     do = do_ref[0, 0]
-    # lse is read once per grid step, so the packed (1, block_q) row (used
-    # above STREAM_THRESHOLD, where the forward streamed and emitted the
-    # packed layout) affords a single entry transpose.
-    lse = _read_lse(lse_ref, 0, packed)
+    # lse is read once per grid step, so the non-legacy layouts afford a
+    # single restore each: "packed" (1, block_q) row (above
+    # STREAM_THRESHOLD, where the forward streamed) transposes; "blocked"
+    # (the resident default) unwraps its rows of the full (S/128, 128)
+    # plane back to the (block_q, 1) column.
+    if lse_layout == "blocked":
+        # Mosaic cannot shape-cast (rows, 128) -> (block_q, 1) directly;
+        # per-row (1, 128) -> (128, 1) transposes (the op the packed
+        # path uses) + a sublane concat restore the column.
+        rows = q2.shape[0] // 128
+        band = lse_ref[0, 0, pl.ds(qi * rows, rows), :]
+        lse = jnp.concatenate(
+            [jnp.transpose(band[r:r + 1, :]) for r in range(rows)], axis=0)
+    else:
+        lse = _read_lse(lse_ref, 0, lse_layout)
     delta = _delta(do, o_ref[0, 0])
     block_q, d = q2.shape
     s_k = k_ref.shape[2]
@@ -529,7 +576,7 @@ def _stream_bounds(ki, q_start, block_q, n_k, block_k, causal):
 
 
 def _fwd_stream_kernel(*refs, block_q: int, block_k: int,
-                       scale: float, causal: bool, packed: bool,
+                       scale: float, causal: bool, lse_layout: str,
                        rope: bool = False):
     # grid (b, h, qi, ki), ki innermost/sequential. q_ref/o_ref:
     # (1, 1, block_q, D) at qi; k_ref/v_ref: (1, 1, block_k, D) at ki;
@@ -580,12 +627,13 @@ def _fwd_stream_kernel(*refs, block_q: int, block_k: int,
         l = l_scr[...][:, 0]
         o_ref[0, 0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
         lse = m_scr[...][:, 0] + jnp.log2(l)
-        lse_ref[0, 0] = lse[None, :] if packed else lse[:, None]
+        lse_ref[0, 0] = (lse[None, :] if lse_layout == "packed"
+                         else lse[:, None])
 
 
 def _dq_stream_kernel(*refs, block_q: int,
                       block_k: int, scale: float, causal: bool,
-                      packed: bool, rope: bool = False):
+                      lse_layout: str, rope: bool = False):
     # grid (b, h, qi, ki), ki innermost. Same tiling as _fwd_stream_kernel
     # plus do/o at qi; lse: (1, 1, 1, block_q). Scratch: dq (block_q, D)
     # fp32, delta and column-oriented lse (block_q, 1) fp32, all persisting
@@ -609,7 +657,7 @@ def _dq_stream_kernel(*refs, block_q: int,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
         delta_scr[...] = _delta(do_ref[0, 0], o_ref[0, 0])
-        lse_scr[...] = _read_lse(lse_ref, 0, packed)
+        lse_scr[...] = _read_lse(lse_ref, 0, lse_layout)
         if rope:
             q2_scr[...] = _rope_rot(q_ref[0, 0], cq_ref[...], sq_ref[...],
                                     scale * LOG2E)
@@ -639,7 +687,7 @@ def _dq_stream_kernel(*refs, block_q: int,
 
 def _dkv_stream_kernel(*refs, block_q: int,
                        block_k: int, scale: float, causal: bool,
-                       packed: bool, rope: bool = False):
+                       lse_layout: str, rope: bool = False):
     # grid (b, kv_head, ki, qi), qi innermost. k/v/dk/dv: (1, 1, block_k, D)
     # at ki; q/do/o: (1, G, block_q, D) at qi; lse: (1, G, 1, block_q).
     # delta is recomputed per (g, qi) step — negligible next to the tile's
@@ -689,7 +737,7 @@ def _dkv_stream_kernel(*refs, block_q: int,
             else:
                 q2 = _prescale_q(q_ref[0, g], scale)
             dk_c, dv_c = _dkv_tile(q2, k, v, do_ref[0, g],
-                                   _read_lse(lse_ref, g, packed),
+                                   _read_lse(lse_ref, g, lse_layout),
                                    _delta(do_ref[0, g], o_ref[0, g]),
                                    q_start, k_start, masked)
             dk_acc, dv_acc = dk_acc + dk_c, dv_acc + dv_c
@@ -752,12 +800,17 @@ def _flash_fwd_t(qt, kt, vt, causal, interpret, rope_tables=None):
     group = h // kv_heads
     block_q, block_k = _blocks(s, *_active_tiles(s)[0])
     scale = 1.0 / (d ** 0.5)
-    packed = _lse_layout(s)  # streaming family only; resident is legacy
-    lse_shape = (b, h, 1, s) if packed else (b, h, s, 1)
-    if packed:
+    layout = _lse_layout(s)
+    if layout == "packed":
+        lse_shape = (b, h, 1, s)
         lse_spec = pl.BlockSpec((1, 1, 1, block_q),
                                 lambda bi, hi, qi, *_: (bi, hi, 0, qi))
+    elif layout == "blocked":
+        lse_shape = (b, h, s // 128, 128)
+        lse_spec = pl.BlockSpec((1, 1, s // 128, 128),
+                                lambda bi, hi, qi, *_: (bi, hi, 0, 0))
     else:
+        lse_shape = (b, h, s, 1)
         lse_spec = pl.BlockSpec((1, 1, block_q, 1),
                                 lambda bi, hi, qi, *_: (bi, hi, qi, 0))
     out_shape = [
@@ -772,7 +825,8 @@ def _flash_fwd_t(qt, kt, vt, causal, interpret, rope_tables=None):
     rope = rope_tables is not None
     if s <= STREAM_THRESHOLD:
         kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
-                                   causal=causal, rope=rope, group=group)
+                                   causal=causal, rope=rope, group=group,
+                                   lse_blocked=(layout == "blocked"))
         in_specs = [
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -799,7 +853,8 @@ def _flash_fwd_t(qt, kt, vt, causal, interpret, rope_tables=None):
     else:
         kernel = functools.partial(_fwd_stream_kernel, block_q=block_q,
                                    block_k=block_k, scale=scale,
-                                   causal=causal, packed=packed, rope=rope)
+                                   causal=causal, lse_layout=layout,
+                                   rope=rope)
         # Causal: grid steps past the diagonal are no-ops in the kernel, so
         # clamp their K/V block index to the last useful one — an unchanged
         # index makes the pipeline skip the HBM fetch entirely.
@@ -875,7 +930,7 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
     dq_bq, dq_bk = _blocks(s, dq_q, dq_k)
     dkv_bq, dkv_bk = _blocks(s, dkv_q, dkv_k)
     scale = 1.0 / (d ** 0.5)
-    packed = _lse_layout(s)
+    layout = _lse_layout(s)
     rope = rope_tables is not None
     # delta (rowwise dO . O) is computed inside the kernels from the do/o
     # tiles (see _delta) — no fp32 materialization at the XLA level.
@@ -887,9 +942,12 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
         # the forward emitted the packed lse layout.
         q_spec = pl.BlockSpec((1, 1, dq_bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
         kv_full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // group, 0, 0))
-        if packed:
+        if layout == "packed":
             row_spec = pl.BlockSpec((1, 1, 1, dq_bq),
                                     lambda bi, hi, qi: (bi, hi, 0, qi))
+        elif layout == "blocked":
+            row_spec = pl.BlockSpec((1, 1, s // 128, 128),
+                                    lambda bi, hi, qi: (bi, hi, 0, 0))
         else:
             row_spec = pl.BlockSpec((1, 1, dq_bq, 1),
                                     lambda bi, hi, qi: (bi, hi, qi, 0))
@@ -905,7 +963,7 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
             scratch.append(pltpu.VMEM((s, d), kt.dtype))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, block_k=dq_bk, scale=scale,
-                              causal=causal, group=group, packed=packed,
+                              causal=causal, group=group, lse_layout=layout,
                               rope=rope),
             grid=(b, h, s // dq_bq),
             in_specs=in_specs,
@@ -929,7 +987,7 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
             def dq_kv_idx(bi, hi, qi, ki):
                 return (bi, hi // group, ki, 0)
         kv_spec = pl.BlockSpec((1, 1, dq_bk, d), dq_kv_idx)
-        if packed:
+        if layout == "packed":
             row_spec = pl.BlockSpec((1, 1, 1, dq_bq),
                                     lambda bi, hi, qi, ki: (bi, hi, 0, qi))
         else:
@@ -956,7 +1014,7 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
             scratch.append(pltpu.VMEM((dq_bq, d), qt.dtype))
         dq = pl.pallas_call(
             functools.partial(_dq_stream_kernel, block_q=dq_bq, block_k=dq_bk,
-                              scale=scale, causal=causal, packed=packed,
+                              scale=scale, causal=causal, lse_layout=layout,
                               rope=rope),
             grid=(b, h, s // dq_bq, s // dq_bk),
             in_specs=in_specs,
@@ -987,7 +1045,8 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
                 return (bi, hi, 0, qi)
         qgrp_spec = pl.BlockSpec((1, group, dkv_bq, d), dkv_q_idx)
         rowgrp_spec = (
-            pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx) if packed
+            pl.BlockSpec((1, group, 1, dkv_bq), dkv_row_idx)
+            if layout == "packed"
             else pl.BlockSpec((1, group, dkv_bq, 1), dkv_q_idx))
         in_specs = [qgrp_spec, kv_spec, kv_spec, qgrp_spec, rowgrp_spec,
                     qgrp_spec]
@@ -1010,7 +1069,7 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_stream_kernel, block_q=dkv_bq,
                               block_k=dkv_bk, scale=scale, causal=causal,
-                              packed=packed, rope=rope),
+                              lse_layout=layout, rope=rope),
             grid=(b, kv_heads, s // dkv_bk, s // dkv_bq),
             in_specs=in_specs,
             out_specs=[kv_spec, kv_spec],

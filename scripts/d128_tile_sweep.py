@@ -1,0 +1,84 @@
+"""D=128 tile mini-sweep (VERDICT r4 next-step #7).
+
+Every tile constant in ops/flash_attention.py was tuned at D=64 (the
+gpt2-125m bench head width). The flagship llama3-8b preset runs D=128 —
+this sweep times the resident family's fwd+bwd at a llama-shaped GQA
+config (h:kv = 4:1, D=128, S=2048 — the S*D budget boundary, so the
+fused backward is engaged exactly as the flagship would) across tile
+candidates, on the chip, to decide whether the D=64 constants transfer
+or need a D=128 dispatch branch.
+
+Run on the TPU:  python scripts/d128_tile_sweep.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
+    from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
+
+    b, s, h, kv, d = 4, 2048, 8, 2, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, True).astype(
+            jnp.float32) ** 2)
+
+    defaults = dict(FWD_BLOCK_Q=fa.FWD_BLOCK_Q, FWD_BLOCK_K=fa.FWD_BLOCK_K,
+                    DQ_BLOCK_Q=fa.DQ_BLOCK_Q, DQ_BLOCK_K=fa.DQ_BLOCK_K,
+                    DKV_BLOCK_Q=fa.DKV_BLOCK_Q, DKV_BLOCK_K=fa.DKV_BLOCK_K)
+
+    combos = [
+        ("default D64 tiles (512,512|512,512|512,1024)", {}),
+        ("fwd 256x512", dict(FWD_BLOCK_Q=256, FWD_BLOCK_K=512)),
+        ("fwd 512x256", dict(FWD_BLOCK_Q=512, FWD_BLOCK_K=256)),
+        ("fwd 256x256", dict(FWD_BLOCK_Q=256, FWD_BLOCK_K=256)),
+        ("fwd 1024x512", dict(FWD_BLOCK_Q=1024, FWD_BLOCK_K=512)),
+        ("dq 256x512", dict(DQ_BLOCK_Q=256, DQ_BLOCK_K=512)),
+        ("dq 512x256", dict(DQ_BLOCK_Q=512, DQ_BLOCK_K=256)),
+        ("dkv 512x512", dict(DKV_BLOCK_Q=512, DKV_BLOCK_K=512)),
+        ("dkv 1024x512", dict(DKV_BLOCK_Q=1024, DKV_BLOCK_K=512)),
+        ("dkv 256x1024", dict(DKV_BLOCK_Q=256, DKV_BLOCK_K=1024)),
+    ]
+
+    results = []
+    for tag, over in combos:
+        for name, val in {**defaults, **over}.items():
+            setattr(fa, name, val)
+        try:
+            g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+            out = g(q, k, v)
+            hard_sync(out[0])
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    out = g(q, k, v)
+                hard_sync(out[0])
+                best = min(best, (time.perf_counter() - t0) / 20)
+            results.append((best, tag))
+            print(f"{tag:48s} {best * 1000:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"{tag:48s} FAILED: {str(e)[:120]}", flush=True)
+    for name, val in defaults.items():
+        setattr(fa, name, val)
+    results.sort()
+    print(f"\nbest: {results[0][1]} ({results[0][0] * 1000:.2f} ms); "
+          f"default at {[r for r in results if 'default' in r[1]][0][0] * 1000:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

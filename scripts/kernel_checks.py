@@ -213,7 +213,15 @@ def main():
     ok &= check_flash_parity(16384, 4, 2, 64)    # split streaming bwd, GQA
     ok &= check_rope_fused_parity(2048, 12, 12, 64)  # in-kernel rope, bench
     ok &= check_rope_fused_parity(4096, 4, 2, 64)    # rope + streamed fwd
+    # D=128 (the flagship llama head width; VERDICT r4 next-step #7): the
+    # budgets and tiles were calibrated at D=64 — these pin that the
+    # dispatch is CORRECT at double the head width, at the S*D boundary
+    # (2048*128 == the fused-backward budget) and past it (split bwd).
+    ok &= check_flash_parity(2048, 4, 2, 128)    # boundary, GQA
+    ok &= check_flash_parity(4096, 4, 2, 128)    # above budget: split bwd
+    ok &= check_rope_fused_parity(2048, 4, 2, 128)  # rope AT the boundary
     ok &= check_ring_carry_64k()
+    ok &= check_ring_carry_64k(s=32768, sp=4, h=2, kv=2, d=128)
     sys.exit(0 if ok else 1)
 
 
