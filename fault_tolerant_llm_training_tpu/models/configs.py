@@ -62,8 +62,12 @@ class TransformerConfig:
     # at bs 16 (BASELINE.md round 4).
     fused_wo: bool = True
     # Project q/k/v via 'bsd,dhe->bhse' einsums so they land head-major
-    # (the input-side mirror of fused_wo). Round-4 experiment knob.
-    qkv_einsum: bool = False
+    # (the input-side mirror of fused_wo). Measured neutral in round 4;
+    # under round 5's blocked lse layout it WINS both regimes — +0.9%
+    # headline (124.2k vs 123.1k) and +3.6% at bs 16 (118.6k vs 114.5k),
+    # the reduced allocator pressure evidently freeing the input-side
+    # transpose elision to pay off (BASELINE.md round 5). Default ON.
+    qkv_einsum: bool = True
     # SwiGLU gate+up in one (D, 2*hidden) matmul, split after. Default ON:
     # +2.2% on the headline bench stacked on the in-kernel rope
     # (BASELINE.md round 4); parity with separate matmuls is reduction-
