@@ -142,15 +142,33 @@ class Attention(nn.Module):
         dense = dict(use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=_DENSE_INIT)
         b, s = x.shape[0], x.shape[1]
+        # Attention-path resolution BEFORE the projections: the head-major
+        # einsum form only pays where a head-major consumer follows (the
+        # fused-rope / bhsd kernel branches). On the streaming/ring/XLA
+        # paths the canonical transpose-back costs more than the Dense it
+        # replaced (S=8192: 39.7k vs 40.3k tokens/s, −1.4% — BASELINE.md
+        # round 5), so those keep the Dense projections.
+        impl = cfg.attention_impl
+        ring = impl in ("auto", "ring") and mesh_axis_size("sequence") > 1
+        resolved = impl
+        if impl in ("auto", "ring"):
+            resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from ..ops.flash_attention import rope_fused_profitable
+        fused_rope_branch = (not ring and resolved == "pallas"
+                             and positions is None
+                             and cfg.rope_impl == "fused"
+                             and rope_fused_profitable(s, dh))
+        bhsd_branch = (not fused_rope_branch and not ring
+                       and resolved == "pallas" and positions is None
+                       and cfg.qkv_layout == "bhsd")
         head_major = None  # (qt, kt, vt) in (B, H, S, D) when qkv_einsum
-        if cfg.qkv_einsum:
+        if cfg.qkv_einsum and (fused_rope_branch or bhsd_branch):
             # Head-major projections: contract x against the (D, H, dh)
             # views so q/k/v land directly in the flash kernels'
             # (B, H, S, D) layout — no activation-side transpose between
             # projection and kernel (pairs with fused_wo on the output
-            # side). The rope_impl='fused' branch below consumes
-            # head_major as-is; other paths transpose to the canonical
-            # (B, S, H, D).
+            # side). Only taken when the selected branch consumes
+            # head_major natively (see the gate above).
             def proj(name, heads):
                 w = _Kernel((cfg.dim, heads * dh), cfg.param_dtype,
                             name=name)()
@@ -184,15 +202,7 @@ class Attention(nn.Module):
             v = nn.Dense(nkv, name="wv", **dense)(x).reshape(
                 b, s, cfg.kv_heads, dh)
 
-        impl = cfg.attention_impl
-        ring = impl in ("auto", "ring") and mesh_axis_size("sequence") > 1
-        resolved = impl
-        if impl in ("auto", "ring"):
-            resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
-        from ..ops.flash_attention import rope_fused_profitable
-        if (not ring and resolved == "pallas" and positions is None
-                and cfg.rope_impl == "fused"
-                and rope_fused_profitable(s, dh)):
+        if fused_rope_branch:
             # RoPE inside the kernels (ops/flash_attention.py
             # flash_attention_rope): raw head-major q/k/v plus the
             # interleave-duplicated (S, D) tables. No rotated q/k or rope
@@ -220,8 +230,7 @@ class Attention(nn.Module):
                     "bhsd,hde->bse", out_t,
                     wo.reshape(cfg.n_heads, dh, cfg.dim).astype(cfg.dtype))
             out = jnp.transpose(out_t, (0, 2, 1, 3))
-        elif (not ring and resolved == "pallas" and positions is None
-                and cfg.qkv_layout == "bhsd"):
+        elif bhsd_branch:
             # Kernel-native layout path: transpose BEFORE rope so the rope
             # fusion computes in (and emits) exactly the (B, H, S, D)
             # layout the Pallas custom call consumes — the bshd path below
@@ -246,10 +255,9 @@ class Attention(nn.Module):
             # outer product (sharded with the activations) rather than a
             # table gather, which the SPMD partitioner can only reshard by
             # full rematerialization.
-            if head_major is not None:  # qkv_einsum fell through to here
-                q = jnp.transpose(head_major[0], (0, 2, 1, 3))
-                k = jnp.transpose(head_major[1], (0, 2, 1, 3))
-                v = jnp.transpose(head_major[2], (0, 2, 1, 3))
+            # head_major cannot reach here: the einsum projections are
+            # gated on (fused_rope_branch or bhsd_branch) above, so this
+            # path always has canonical Dense q/k/v.
             if positions is None:
                 cos, sin = precompute_rope(dh, cfg.seq_len, cfg.rope_theta)
             else:
