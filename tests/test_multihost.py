@@ -305,6 +305,30 @@ def test_two_process_peer_death_degrades_cleanly(tmp_path, parquet2):
             list(root.iterdir()))
 
 
+def test_three_process_local_error_fence(tmp_path, parquet2):
+    """The fence is N-generic, not a 2-host special case: with three hosts,
+    one raising alone, gather_stops collects two peers' stops, the laggards
+    catch up to the cluster maximum, and all three save the SAME step and
+    exit 0 without resubmitting."""
+    import re
+
+    ckpt = str(tmp_path / "ckpts")
+    rcs, outs = _launch_pair(
+        ["--dataset", parquet2, "--checkpoint-path", ckpt,
+         "--training-steps", "100000", "--signal-sync-frequency", "3",
+         "--batch-size", "6",  # divisible by 3 hosts' data sharding
+         "--raise-error", "--error-step", "6", "--error-local-rank", "1",
+         "--peer-timeout-seconds", "60", "--resubmit-command", "true"],
+        job_id="mh3_localerr", n=3)
+    assert rcs == [0, 0, 0], outs
+    saved = [re.search(r"Checkpoint saved at step (\d+)", o) for o in outs]
+    assert all(saved), outs
+    assert len({m.group(1) for m in saved}) == 1, "hosts saved different steps"
+    for o in outs:
+        assert "sbatch requeued" not in o, o
+        assert "terminating without a checkpoint" not in o, o
+
+
 def test_two_process_sharded_data_matches_replicated(tmp_path, parquet2):
     """--data-sharding host (the pod default via auto) must reproduce the
     replicated-read trajectory line-for-line: same losses, same grad
