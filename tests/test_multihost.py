@@ -47,6 +47,40 @@ def test_synced_check_raises_same_signal():
     flag.check(synced=True)  # cleared after raise
 
 
+def test_watchdog_paths():
+    """The fence's bounded-wait primitive: completion returns the value,
+    exceptions re-raise in the caller, a timeout abandons with the
+    cancellation token set, and a positive poll abandons within the poll
+    interval instead of burning the whole timeout."""
+    import time
+
+    from fault_tolerant_llm_training_tpu.ft.multihost import watchdog
+
+    ok, val = watchdog(lambda c: 42, 5.0)
+    assert ok and val == 42
+
+    with pytest.raises(RuntimeError, match="boom"):
+        watchdog(lambda c: (_ for _ in ()).throw(RuntimeError("boom")), 5.0)
+
+    seen = {}
+
+    def _slow(cancelled):
+        seen["cancelled"] = cancelled
+        time.sleep(30)
+
+    t0 = time.monotonic()
+    ok, val = watchdog(_slow, 0.3)
+    assert not ok and val is None
+    assert time.monotonic() - t0 < 5
+    assert seen["cancelled"].is_set()  # abandoned thread was told
+
+    t0 = time.monotonic()
+    ok, _ = watchdog(lambda c: time.sleep(30), 30.0,
+                     poll=lambda: True, poll_seconds=0.2)
+    assert not ok
+    assert time.monotonic() - t0 < 5  # poll cut the wait, not the timeout
+
+
 class _StubTrainer:
     def __init__(self, replicated):
         self.state = object()
