@@ -46,7 +46,14 @@ def _splitmix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
-def _feistel_row(idx: int, n: int, seed: int, epoch: int) -> int:
+def _feistel_keys(seed: int, epoch: int):
+    """The 4 per-epoch Feistel round keys — pure in (seed, epoch); callers
+    on the per-sample path cache them per epoch (review r5: rederiving
+    them per lookup doubled the hash work the O(1) path exists to save)."""
+    return [_splitmix64((seed << 32) ^ (epoch << 8) ^ r) for r in range(4)]
+
+
+def _feistel_row(idx: int, n: int, seed: int, epoch: int, keys=None) -> int:
     """Position -> row under a keyed bijection of [0, n): O(1) memory.
 
     A 4-round balanced Feistel network over the smallest even-bit power-of
@@ -60,7 +67,8 @@ def _feistel_row(idx: int, n: int, seed: int, epoch: int) -> int:
     bits += bits & 1  # balanced halves
     half = bits // 2
     mask = (1 << half) - 1
-    keys = [_splitmix64((seed << 32) ^ (epoch << 8) ^ r) for r in range(4)]
+    if keys is None:
+        keys = _feistel_keys(seed, epoch)
     x = idx
     while True:
         left, right = x >> half, x & mask
@@ -148,8 +156,13 @@ class _ShuffleMixin:
             return self._holdout_rows + idx % n
         epoch, pos = divmod(idx, n)
         if self._shuffle_impl == "feistel":
+            if self._perm_epoch != epoch:  # reuse the exact path's marker
+                self._feistel_epoch_keys = _feistel_keys(self._shuffle_seed,
+                                                         epoch)
+                self._perm_epoch = epoch
             return self._holdout_rows + _feistel_row(
-                pos, n, self._shuffle_seed, epoch)
+                pos, n, self._shuffle_seed, epoch,
+                keys=self._feistel_epoch_keys)
         if self._perm_epoch != epoch:
             self._perm = _epoch_perm(n, self._shuffle_seed, epoch)
             self._perm_epoch = epoch

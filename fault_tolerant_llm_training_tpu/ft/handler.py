@@ -98,7 +98,8 @@ def handle_exit(trainer, error_type: int, logger) -> None:
             if not coordinated and jax.process_count() > 1:
                 coordinated = trainer.coordinate_local_error()
             saved_step = trainer.save_checkpoint(wait=True,
-                                                 coordinated=coordinated)
+                                                 coordinated=coordinated,
+                                                 fault=True)
             logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
         else:
             logger.info("[EXIT HANDLER] No training state to save yet.")

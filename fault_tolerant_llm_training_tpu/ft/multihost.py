@@ -7,9 +7,10 @@ different times, and a host that unilaterally stops stepping deadlocks the
 others inside the next XLA collective. The protocol here:
 
 1. every host records signals locally (ft/signals.py flag pattern);
-2. at each check boundary the hosts *agree* on one verdict via a tiny
-   process allgather (``agree_on_signal``) — so either every host raises
-   ``TrainingSignal`` at the same step, or none does;
+2. at each check boundary the hosts *agree* on one verdict via a KV-store
+   voting round (``agree_on_signal`` — host-side gRPC, no device
+   collective) — so either every host raises ``TrainingSignal`` at the
+   same step, or none does;
 3. the coordinated Orbax save runs on all hosts (sharded per-host writes,
    Orbax's own barrier commits atomically);
 4. only process 0 resubmits the Slurm chain (``should_resubmit``) — the
@@ -64,6 +65,9 @@ _TERM = int(signal.SIGTERM)  # 15: no save
 _ERR_PREFIX = "ftl_fault/err/"
 _STOP_PREFIX = "ftl_fault/stop/"
 _DEAD_PREFIX = "ftl_fault/dead/"
+# Signal-agreement rounds: ftl_sig/<round>/<proc> (rounds are the loop's
+# boundary counter, identical on every host by construction).
+_SIG_PREFIX = "ftl_sig/"
 
 # Audit line for the degraded (dead-peer) exit; tests and operators grep it.
 AUDIT_UNCOORDINATED_FMT = ("[EXIT HANDLER] Pod fault fence failed ({reason}); "
@@ -94,19 +98,76 @@ def combine_signals(signums: Iterable[int]) -> Optional[int]:
     return min(seen)  # deterministic pick for exotic codes
 
 
-def agree_on_signal(local_signum: Optional[int]) -> Optional[int]:
-    """Allgather each host's pending signal and apply ``combine_signals``.
+def agree_on_signal(local_signum: Optional[int],
+                    round_id: Optional[int] = None,
+                    timeout_seconds: float = 300.0,
+                    logger=None) -> Optional[int]:
+    """One cluster-wide signal verdict per sync boundary, over the
+    jax.distributed KV store — publish ``ftl_sig/<round>/<me>``, poll
+    every peer's key, ``combine_signals`` the votes.
 
-    Single-process (the reference's regime and all CPU tests): identity.
-    """
+    Until round 5 this was a device-collective ``process_allgather``,
+    which (a) forced a full dispatch-pipeline drain at every boundary
+    (a device collective issued concurrently with in-flight steps
+    interleaves differently across hosts), and (b) could WEDGE a
+    survivor's device queue forever when a peer faulted after the
+    survivor entered the allgather — queued device programs cannot be
+    abandoned, so even the fence's eventual pre-save barrier queued
+    behind the dead collective and the whole pod lost its checkpoint
+    (review r5). The KV round involves no device work: no drain is
+    needed, a peer's fault announcement interrupts the wait within the
+    poll interval (→ ``PeerHostError`` → fence → coordinated save), and
+    a silent peer degrades via ``die_uncoordinated`` after
+    ``timeout_seconds``.
+
+    ``round_id`` must advance identically on every host (the loop's
+    boundary counter does; boundaries are a pure function of
+    training_step). ``round_id=None`` is a one-shot round for tests.
+    Each host deletes its own round-(R-2) key when publishing round R —
+    publishing R implies every host completed R-1, which implies nobody
+    still reads R-2 — so the store stays O(hosts). Single-process (the
+    reference's regime and all CPU tests): identity."""
     if jax.process_count() == 1:
         return local_signum
-    import jax.numpy as jnp
-    from jax.experimental import multihost_utils
+    import time as _time
 
-    gathered = multihost_utils.process_allgather(
-        jnp.int32(local_signum or 0))
-    return combine_signals(int(x) for x in gathered.flatten())
+    client = _kv()
+    rid = 0 if round_id is None else int(round_id)
+    me = jax.process_index()
+    try:
+        client.key_value_set(f"{_SIG_PREFIX}{rid}/{me}",
+                             str(int(local_signum or 0)))
+    except Exception:
+        pass  # duplicate set on a retried boundary; the value is identical
+    if round_id is not None and rid >= 2:
+        try:
+            client.key_value_delete(f"{_SIG_PREFIX}{rid - 2}/{me}")
+        except Exception:
+            pass
+    votes = []
+    deadline = _time.monotonic() + timeout_seconds
+    for p in range(jax.process_count()):
+        key = f"{_SIG_PREFIX}{rid}/{p}"
+        while True:
+            try:
+                votes.append(int(client.key_value_try_get(key)))
+                break
+            except Exception:
+                pass  # peer has not published this round yet
+            if peer_error_pending():
+                raise PeerHostError()
+            if _time.monotonic() > deadline:
+                die_uncoordinated(
+                    logger if logger is not None else _default_logger(),
+                    f"peer {p} absent from signal agreement round {rid}")
+            _time.sleep(0.05)
+    return combine_signals(votes)
+
+
+def _default_logger():
+    from ..utils.logging import logger as _l
+
+    return _l
 
 
 def barrier(name: str) -> None:

@@ -281,7 +281,7 @@ def _active_tiles(s: int):
             (DKV_BLOCK_Q, DKV_BLOCK_K))
 
 
-def _lse_layout(s: int) -> str:
+def _lse_layout(s: int, d: int) -> str:
     """The lse residual's memory layout at sequence length ``s``:
 
     - ``"packed"`` — (B, H, 1, S), q positions on the lane dim. Streaming
@@ -307,7 +307,13 @@ def _lse_layout(s: int) -> str:
             and all(_fit_block(s, bq) % 128 == 0
                     for bq, _ in _active_tiles(s))):
         return "packed"
+    # "blocked" additionally requires the FUSED backward (_fused_bwd_fits
+    # needs d): the streaming backward kernels have no blocked row_spec,
+    # and a shrunken FTL_SCOPED_VMEM_KIB budget (or d >= 256) can route
+    # s <= STREAM_THRESHOLD shapes to them while the forward would have
+    # emitted the blocked plane — a trace-time Pallas failure.
     if (s <= STREAM_THRESHOLD and s % 128 == 0
+            and _fused_bwd_fits(s, d)
             and os.environ.get("FTL_LSE_RESIDENT", "blocked") != "legacy"
             and all(_fit_block(s, bq) % 128 == 0
                     for bq, _ in _active_tiles(s))):
@@ -800,7 +806,7 @@ def _flash_fwd_t(qt, kt, vt, causal, interpret, rope_tables=None):
     group = h // kv_heads
     block_q, block_k = _blocks(s, *_active_tiles(s)[0])
     scale = 1.0 / (d ** 0.5)
-    layout = _lse_layout(s)
+    layout = _lse_layout(s, d)
     if layout == "packed":
         lse_shape = (b, h, 1, s)
         lse_spec = pl.BlockSpec((1, 1, 1, block_q),
@@ -930,7 +936,7 @@ def _flash_bwd_t(qt, kt, vt, ot, lse, dot, causal, interpret,
     dq_bq, dq_bk = _blocks(s, dq_q, dq_k)
     dkv_bq, dkv_bk = _blocks(s, dkv_q, dkv_k)
     scale = 1.0 / (d ** 0.5)
-    layout = _lse_layout(s)
+    layout = _lse_layout(s, d)
     rope = rope_tables is not None
     # delta (rowwise dO . O) is computed inside the kernels from the do/o
     # tiles (see _delta) — no fp32 materialization at the XLA level.

@@ -36,7 +36,7 @@ from ..data.prefetch import DevicePrefetcher
 from ..data.tokenizer import load_tokenizer
 from ..ft import multihost
 from ..ft.multihost import PeerHostError, barrier
-from ..ft.signals import SignalFlag
+from ..ft.signals import SignalFlag, TrainingSignal
 from ..models import Transformer, get_config
 from ..parallel.mesh import make_mesh, use_mesh
 from ..parallel.sharding import batch_pspec, param_pspecs
@@ -78,6 +78,7 @@ class Trainer:
         self._batch_iter = None  # live prefetch iterator (fence catch-up)
         self._in_guard = False  # re-entrancy latch for _guarded_wait
         self._fence_done = False  # fence ran; stale err keys must not re-raise
+        self._signal_round = 0  # KV signal-agreement round (sync boundaries)
 
         # Handlers first — signals during the (potentially long) setup are
         # deferred and handled at the next phase boundary instead of killing
@@ -441,8 +442,6 @@ class Trainer:
         try:
             self._loop()
         except Exception as e:
-            from ..ft.signals import TrainingSignal
-
             # A host-local fault must be announced AS THE EXCEPTION UNWINDS
             # (before the exit handler runs the fence): the peers' per-
             # dispatch poll sees the key within one iteration, bounding how
@@ -470,22 +469,31 @@ class Trainer:
                 # no device work, no drain.
                 if multihost.peer_error_pending():
                     raise PeerHostError()
-                # Cluster-wide agreement only at sync boundaries: the
-                # allgather is a blocking collective that drains the
-                # dispatch pipeline (see TrainConfig.signal_sync_frequency).
-                # Off-boundary local raises are skipped — a host raising
-                # alone would deadlock the others in the next collective.
-                # The first iteration always syncs so a signal pending
-                # since before setup (see _setup_check) is handled
-                # immediately even when the resumed step is off-boundary.
+                # Cluster-wide signal agreement at sync boundaries, over
+                # the KV store (ft/multihost.py agree_on_signal): pure
+                # host-side gRPC — no device collective, so the dispatch
+                # pipeline keeps flowing through the boundary, and a peer
+                # that faults or dies mid-agreement cannot wedge this
+                # host's device queue (review r5; the old allgather form
+                # both forced a drain per boundary and could strand a
+                # survivor's queued programs behind a dead collective).
+                # Off-boundary local raises are still skipped — a host
+                # raising alone would deadlock the others in their next
+                # step collectives. The first iteration always syncs so a
+                # signal pending since before setup (see _setup_check) is
+                # handled immediately even when the resumed step is
+                # off-boundary. Round ids advance identically on every
+                # host: boundaries are a pure function of training_step.
                 if first_iteration or self.training_step % sync_freq == 0:
-                    def _boundary(cancelled):
-                        self._drain_inflight(cancelled=cancelled)
-                        if cancelled.is_set():
-                            return  # abandoned: no fresh collectives
-                        self.signal_flag.check(synced=True)
-
-                    self._guarded_wait(_boundary, "signal agreement")
+                    self._signal_round += 1
+                    verdict = multihost.agree_on_signal(
+                        self.signal_flag.signum,
+                        round_id=self._signal_round,
+                        timeout_seconds=self.cfg.peer_timeout_seconds,
+                        logger=logger)
+                    if verdict is not None:
+                        self.signal_flag.signum = None
+                        raise TrainingSignal(verdict)
             else:
                 self.signal_flag.check()
             first_iteration = False
@@ -709,7 +717,8 @@ class Trainer:
     # --------------------------------------------------------------- saving
     def save_checkpoint(self, wait: bool = True,
                         stop_prefetch: bool = True,
-                        coordinated: bool = True) -> int:
+                        coordinated: bool = True,
+                        fault: bool = False) -> int:
         """Checkpoint the state of every *dispatched* step plus the matching
         data position. All dispatched XLA work completes by construction, so
         zero steps are lost (the reference's guarantee: saved @427, resumed
@@ -737,13 +746,18 @@ class Trainer:
             self._guarded_wait(_pre_save, "pre-save drain/barrier")
         step = int(jax.device_get(self.state.step))
         data_state = self._last_data_state or self.loader.get_state()
-        if self._sync_signals and wait:
-            # The sharded write is itself a cross-host collective: a peer
-            # dying after the barrier must not hang the survivors forever.
-            # Bounded by the larger of the peer watchdog and 2x the signal
-            # lead (a fault-path save slower than the lead is lost to the
-            # scheduler anyway); Orbax's atomic commit makes the abandoned
-            # partial write invisible to resume.
+        if self._sync_signals and wait and fault:
+            # FAULT-path saves only: the sharded write is itself a
+            # cross-host collective, and a peer dying after the barrier
+            # must not hang the survivors forever. Bounded by the larger
+            # of the peer watchdog and 2x the signal lead (a fault-path
+            # save slower than the lead is lost to the scheduler anyway);
+            # Orbax's atomic commit makes the abandoned partial write
+            # invisible to resume. HEALTHY periodic saves are NOT
+            # watchdogged (review r5): their first blocking write exists
+            # precisely to measure a slow filesystem, and a legitimate
+            # multi-minute 8B-class write must warn — not silently
+            # exit-0 the whole job.
             bound = max(self.cfg.peer_timeout_seconds,
                         2.0 * self.cfg.signal_lead_seconds)
             ok, _ = multihost.watchdog(

@@ -91,10 +91,12 @@ class TrainConfig:
     grad_accum: int = 1  # gradient-accumulation slices per step (memory/batch)
     lr_schedule: str = "constant"  # constant (reference) | cosine
     lr_decay_steps: int = 0  # cosine horizon (0 = --training-steps)
-    # Multihost: steps between cluster-wide signal agreements. The agreement
-    # is a blocking device allgather that drains the dispatch pipeline, so
-    # running it every step would force inflight=1 on a pod; every N steps
-    # bounds signal latency to N*step_time (vs the 120 s USR1 lead).
+    # Multihost: steps between cluster-wide signal agreements. The
+    # agreement is a host-side KV-store round (ft/multihost.py) — it no
+    # longer drains the dispatch pipeline, but it is still a cluster
+    # rendezvous (every host waits for the slowest), so every N steps
+    # bounds signal latency to N*step_time (vs the 120 s USR1 lead)
+    # without paying the rendezvous each step.
     signal_sync_frequency: int = 5
     # Watchdog bound (seconds) on every blocking multihost wait (metric
     # fetch, signal-agreement allgather, fence stop-gather, pre-save

@@ -73,7 +73,7 @@ def test_fused_backward_with_streamed_forward(s, h, kv, d, monkeypatch):
     by lowering only the forward threshold."""
     import fault_tolerant_llm_training_tpu.ops.flash_attention as fa
     monkeypatch.setattr(fa, "STREAM_THRESHOLD", 0)
-    assert fa._lse_layout(s)  # the combination under test needs packed
+    assert fa._lse_layout(s, d) == "packed"  # the combination under test
     assert fa._fused_bwd_fits(s, d)
     _check_gradients(s, h, kv, d, batch=2, seed=2)
 
@@ -238,11 +238,14 @@ def test_lse_layout_dispatch(monkeypatch):
     from fault_tolerant_llm_training_tpu.ops import flash_attention as fa
 
     monkeypatch.delenv("FTL_LSE_RESIDENT", raising=False)
-    assert fa._lse_layout(2048) == "blocked"   # resident, 128-aligned
-    assert fa._lse_layout(256) == "blocked"
-    assert fa._lse_layout(2000) == "legacy"    # not a 128-multiple
-    assert fa._lse_layout(4096) == "packed"    # streaming
-    assert fa._lse_layout(65536) == "packed"
+    assert fa._lse_layout(2048, 64) == "blocked"   # resident, 128-aligned
+    assert fa._lse_layout(2048, 128) == "blocked"  # exactly at the budget
+    assert fa._lse_layout(256, 64) == "blocked"
+    assert fa._lse_layout(2000, 64) == "legacy"    # not a 128-multiple
+    assert fa._lse_layout(2048, 256) == "legacy"   # fused bwd won't fit:
+    # the streaming backward has no blocked row_spec (review r5)
+    assert fa._lse_layout(4096, 64) == "packed"    # streaming
+    assert fa._lse_layout(65536, 64) == "packed"
     monkeypatch.setenv("FTL_LSE_RESIDENT", "legacy")
-    assert fa._lse_layout(2048) == "legacy"    # opt-out knob
-    assert fa._lse_layout(4096) == "packed"    # knob is resident-only
+    assert fa._lse_layout(2048, 64) == "legacy"    # opt-out knob
+    assert fa._lse_layout(4096, 64) == "packed"    # knob is resident-only

@@ -93,8 +93,9 @@ class _StubTrainer:
         self.fenced = True
         return True
 
-    def save_checkpoint(self, wait=True, coordinated=True):
-        self.saved_with = dict(wait=wait, coordinated=coordinated)
+    def save_checkpoint(self, wait=True, coordinated=True, fault=False):
+        self.saved_with = dict(wait=wait, coordinated=coordinated,
+                               fault=fault)
         return 7
 
 
@@ -113,11 +114,11 @@ def test_host_local_error_runs_fence_then_saves(monkeypatch):
     t = _StubTrainer(replicated=False)
     handler.handle_exit(t, handler.CODE_ERROR, logger)
     assert t.fenced
-    assert t.saved_with == dict(wait=True, coordinated=True)
+    assert t.saved_with == dict(wait=True, coordinated=True, fault=True)
     t = _StubTrainer(replicated=True)
     handler.handle_exit(t, handler.CODE_ERROR, logger)
     assert not t.fenced
-    assert t.saved_with == dict(wait=True, coordinated=True)
+    assert t.saved_with == dict(wait=True, coordinated=True, fault=True)
 
 
 _WORKER = """
