@@ -63,10 +63,13 @@ class SignalFlag:
     def check(self, synced: bool = False) -> None:
         """Raise ``TrainingSignal`` if a fault signal is pending.
 
-        ``synced=True`` first agrees on a cluster-wide verdict with the other
-        hosts (ft/multihost.py): either every host raises at this boundary or
-        none does — a host raising alone would deadlock the rest inside the
-        next XLA collective. Single-process: identical to ``synced=False``.
+        ``synced=True`` first agrees on a cluster-wide verdict with the
+        other hosts (ft/multihost.py ``agree_on_signal``, a one-shot
+        KV-store voting round here — the trainer's loop manages proper
+        round ids itself): either every host raises at this boundary or
+        none does — a host raising alone would deadlock the rest inside
+        the next XLA collective. Single-process: identical to
+        ``synced=False``.
         """
         signum = self.signum
         if synced:

@@ -2,7 +2,7 @@
 
 Real multi-process agreement needs a pod; these tests pin down the policy
 function (pure), the single-process identity paths, and the synced check
-wiring — the pieces that must hold before the allgather even matters.
+wiring — the pieces that must hold before the KV voting round even matters.
 """
 
 import signal
