@@ -42,12 +42,14 @@ moment without draining the dispatch pipeline:
    last-dispatched step, gather everyone's (bounded by a watchdog),
    dispatch real catch-up steps to the cluster maximum, and only then run
    the ordinary coordinated save — every host saves the SAME step;
-4. every blocking multihost wait (metric consume, signal-boundary
-   allgather, stop-gather, pre-save barrier) is wrapped in ``watchdog``:
-   if it times out and no peer error is pending, the peer is presumed dead
-   (SIGKILL, kernel panic) and the survivor degrades to a clean no-save
-   ``exit 0`` (``die_uncoordinated``) instead of hanging until the
-   scheduler shoots it.
+4. every blocking multihost wait is bounded: device-side waits (metric
+   consume, pre-save drain/barrier, the collective checkpoint write) run
+   under ``watchdog``; the KV-side waits (signal agreement, stop-gather)
+   poll their own deadlines. On expiry with no peer-fault announcement
+   pending, the peer is presumed dead (SIGKILL, kernel panic) and the
+   survivor degrades to a clean no-save ``exit 0``
+   (``die_uncoordinated``) instead of hanging until the scheduler shoots
+   it.
 """
 
 import os
@@ -134,11 +136,13 @@ def agree_on_signal(local_signum: Optional[int],
     client = _kv()
     rid = 0 if round_id is None else int(round_id)
     me = jax.process_index()
-    try:
-        client.key_value_set(f"{_SIG_PREFIX}{rid}/{me}",
-                             str(int(local_signum or 0)))
-    except Exception:
-        pass  # duplicate set on a retried boundary; the value is identical
+    # A failed publish must RAISE (review r5): swallowing it would let
+    # this host finish its round on the peers' keys and train on, while
+    # every peer burns the full timeout on the missing key and dies
+    # uncoordinated. Raising routes this host through the normal
+    # host-local-fault path (announce -> fence -> coordinated save).
+    client.key_value_set(f"{_SIG_PREFIX}{rid}/{me}",
+                         str(int(local_signum or 0)))
     if round_id is not None and rid >= 2:
         try:
             client.key_value_delete(f"{_SIG_PREFIX}{rid - 2}/{me}")

@@ -79,6 +79,7 @@ class Trainer:
         self._in_guard = False  # re-entrancy latch for _guarded_wait
         self._fence_done = False  # fence ran; stale err keys must not re-raise
         self._signal_round = 0  # KV signal-agreement round (sync boundaries)
+        self._est_save_seconds = None  # startup write-probe estimate
 
         # Handlers first — signals during the (potentially long) setup are
         # deferred and handled at the next phase boundary instead of killing
@@ -399,6 +400,7 @@ class Trainer:
             logger.warning(f"Checkpoint budget | write probe failed: {e}")
             return
         est = estimate_save_seconds(per_host, tput)
+        self._est_save_seconds = est  # sizes the healthy-save watchdog
         lead = self.cfg.signal_lead_seconds
         logger.info(
             f"Checkpoint budget | state {total / 1e9:.2f} GB "
@@ -746,20 +748,24 @@ class Trainer:
             self._guarded_wait(_pre_save, "pre-save drain/barrier")
         step = int(jax.device_get(self.state.step))
         data_state = self._last_data_state or self.loader.get_state()
-        if self._sync_signals and wait and fault:
-            # FAULT-path saves only: the sharded write is itself a
-            # cross-host collective, and a peer dying after the barrier
-            # must not hang the survivors forever. Bounded by the larger
-            # of the peer watchdog and 2x the signal lead (a fault-path
-            # save slower than the lead is lost to the scheduler anyway);
-            # Orbax's atomic commit makes the abandoned partial write
-            # invisible to resume. HEALTHY periodic saves are NOT
-            # watchdogged (review r5): their first blocking write exists
-            # precisely to measure a slow filesystem, and a legitimate
-            # multi-minute 8B-class write must warn — not silently
-            # exit-0 the whole job.
+        if self._sync_signals and wait:
+            # The sharded write is itself a cross-host collective — a peer
+            # dying mid-write must not hang the survivors until the
+            # scheduler shoots them (that would break the exit-0
+            # never-mark-failed contract). FAULT-path bound: the larger of
+            # the peer watchdog and 2x the signal lead (a fault save
+            # slower than the lead is lost to the scheduler anyway).
+            # HEALTHY blocking saves (the first periodic write, which
+            # exists to measure the real filesystem) get a bound scaled to
+            # the startup write-probe estimate with a 10x margin — a slow
+            # but live filesystem warns, only a genuinely wedged
+            # collective degrades (review r5, both directions). Orbax's
+            # atomic commit makes an abandoned partial write invisible.
             bound = max(self.cfg.peer_timeout_seconds,
                         2.0 * self.cfg.signal_lead_seconds)
+            if not fault:
+                est = self._est_save_seconds
+                bound = max(bound, 10.0 * est if est else 3600.0, 600.0)
             ok, _ = multihost.watchdog(
                 lambda _c: self.ckpt_mngr.save(step, self.state, data_state,
                                                wait=True), bound)

@@ -98,12 +98,13 @@ class TrainConfig:
     # bounds signal latency to N*step_time (vs the 120 s USR1 lead)
     # without paying the rendezvous each step.
     signal_sync_frequency: int = 5
-    # Watchdog bound (seconds) on every blocking multihost wait (metric
-    # fetch, signal-agreement allgather, fence stop-gather, pre-save
-    # barrier/drain). A wait outliving it with a peer-fault announcement
-    # pending routes to the fault fence; with none, the peer is presumed
-    # dead and the host degrades to a clean no-save exit 0. Must exceed
-    # the slowest legitimate step + drain on the target pod.
+    # Bound (seconds) on every blocking multihost wait (metric fetch, the
+    # KV signal-agreement round, fence stop-gather, pre-save barrier/
+    # drain; the collective checkpoint write uses a derived, larger
+    # bound). A wait outliving it with a peer-fault announcement pending
+    # routes to the fault fence; with none, the peer is presumed dead and
+    # the host degrades to a clean no-save exit 0. Must exceed the
+    # slowest legitimate step + drain on the target pod.
     peer_timeout_seconds: float = 300.0
     # The scheduler's pre-termination warning lead (seconds): Slurm arms
     # SIGUSR1 this long before the time limit (ref train.sh:12,
