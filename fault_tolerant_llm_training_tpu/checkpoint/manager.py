@@ -101,6 +101,19 @@ def estimate_save_seconds(state_bytes_per_host: int,
                                       * ORBAX_WRITE_EFFICIENCY, 1e-6)
 
 
+def _pytree_handler_kwargs() -> dict:
+    """zarr3 without compression (module docstring: 3x faster saves for ~8%
+    more disk). ``use_compression`` only exists on newer orbax; older ones
+    (0.7.x) write zarr3 uncompressed by default, so just drop the kwarg."""
+    import inspect
+
+    kwargs = {"use_zarr3": True}
+    params = inspect.signature(ocp.PyTreeCheckpointHandler.__init__).parameters
+    if "use_compression" in params:
+        kwargs["use_compression"] = False
+    return kwargs
+
+
 class CheckpointManager:
     def __init__(self, checkpoint_path: str, job_id: str,
                  enable_async: bool = True, max_to_keep: int = 2):
@@ -118,8 +131,7 @@ class CheckpointManager:
             # item_handlers dict disables per-item auto-resolution, so
             # the JSON data item must be registered alongside.)
             item_handlers={
-                "state": ocp.PyTreeCheckpointHandler(
-                    use_compression=False, use_zarr3=True),
+                "state": ocp.PyTreeCheckpointHandler(**_pytree_handler_kwargs()),
                 "data": ocp.JsonCheckpointHandler(),
             })
         self.last_save_seconds: Optional[float] = None

@@ -27,6 +27,8 @@ import os
 
 import jax
 
+from .multihost import PeerHostError
+
 from ..utils.config import JOBID, WORKDIR
 from ..utils.logging import (
     AUDIT_CANCELLED,
@@ -97,9 +99,23 @@ def handle_exit(trainer, error_type: int, logger) -> None:
                            or getattr(trainer, "error_is_replicated", False))
             if not coordinated and jax.process_count() > 1:
                 coordinated = trainer.coordinate_local_error()
-            saved_step = trainer.save_checkpoint(wait=True,
-                                                 coordinated=coordinated,
-                                                 fault=True)
+            try:
+                saved_step = trainer.save_checkpoint(wait=True,
+                                                     coordinated=coordinated,
+                                                     fault=True)
+            except PeerHostError:
+                # A peer faulted DURING this save (its announcement tripped
+                # a guarded wait inside the drain/barrier). Escaping here
+                # would skip the checkpoint entirely (ADVICE r5): instead
+                # run the fence now — it converges every host on the same
+                # step — and retry the save once, coordinated. The fence's
+                # no-return degraded paths still cover dead peers.
+                logger.info("[EXIT HANDLER] Peer fault during save; "
+                            "running the fence and retrying once.")
+                trainer.coordinate_local_error()
+                saved_step = trainer.save_checkpoint(wait=True,
+                                                     coordinated=True,
+                                                     fault=True)
             logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
         else:
             logger.info("[EXIT HANDLER] No training state to save yet.")

@@ -52,7 +52,9 @@ moment without draining the dispatch pipeline:
    it.
 """
 
+import itertools
 import os
+import queue
 import signal
 import threading
 from typing import Callable, Dict, Iterable, Optional, Tuple
@@ -68,8 +70,11 @@ _ERR_PREFIX = "ftl_fault/err/"
 _STOP_PREFIX = "ftl_fault/stop/"
 _DEAD_PREFIX = "ftl_fault/dead/"
 # Signal-agreement rounds: ftl_sig/<round>/<proc> (rounds are the loop's
-# boundary counter, identical on every host by construction).
+# boundary counter, identical on every host by construction). One-shot
+# rounds (round_id=None) use ftl_sig/oneshot<n>/<proc> with a process-local
+# monotonic counter — "oneshot" cannot collide with the integer round ids.
 _SIG_PREFIX = "ftl_sig/"
+_ONESHOT_ROUNDS = itertools.count()
 
 # Audit line for the degraded (dead-peer) exit; tests and operators grep it.
 AUDIT_UNCOORDINATED_FMT = ("[EXIT HANDLER] Pod fault fence failed ({reason}); "
@@ -124,17 +129,24 @@ def agree_on_signal(local_signum: Optional[int],
 
     ``round_id`` must advance identically on every host (the loop's
     boundary counter does; boundaries are a pure function of
-    training_step). ``round_id=None`` is a one-shot round for tests.
-    Each host deletes its own round-(R-2) key when publishing round R —
-    publishing R implies every host completed R-1, which implies nobody
-    still reads R-2 — so the store stays O(hosts). Single-process (the
-    reference's regime and all CPU tests): identity."""
+    training_step). ``round_id=None`` draws a fresh round from a
+    process-local monotonic counter in a reserved ``oneshot`` namespace:
+    a constant key here would make a second synced check collide on the
+    write-once publish and read the first round's stale votes (ADVICE
+    r5). One-shot callers must therefore make the same *sequence* of
+    one-shot calls on every host — the same lockstep contract explicit
+    round ids already require. Each host deletes its own round-(R-2) key
+    when publishing round R — publishing R implies every host completed
+    R-1, which implies nobody still reads R-2 — so the store stays
+    O(hosts). Single-process (the reference's regime and all CPU tests):
+    identity."""
     if jax.process_count() == 1:
         return local_signum
     import time as _time
 
     client = _kv()
-    rid = 0 if round_id is None else int(round_id)
+    rid = (f"oneshot{next(_ONESHOT_ROUNDS)}" if round_id is None
+           else int(round_id))
     me = jax.process_index()
     # A failed publish must RAISE (review r5): swallowing it would let
     # this host finish its round on the peers' keys and train on, while
@@ -154,7 +166,7 @@ def agree_on_signal(local_signum: Optional[int],
         key = f"{_SIG_PREFIX}{rid}/{p}"
         while True:
             try:
-                votes.append(int(client.key_value_try_get(key)))
+                votes.append(int(_kv_try_get(client, key)))
                 break
             except Exception:
                 pass  # peer has not published this round yet
@@ -200,6 +212,19 @@ def _kv():
     return distributed.global_state.client
 
 
+def _kv_try_get(client, key: str) -> str:
+    """``key_value_try_get`` only exists on newer jaxlibs. Emulate it with
+    a short-deadline blocking get on older ones — both raise when the key
+    is not yet published, which is exactly what the poll loops catch. An
+    AttributeError here must NOT reach those loops' blanket excepts: it
+    looks identical to 'peer not published yet' and silently burns the
+    whole agreement timeout on every call (seen on jaxlib 0.4.36)."""
+    try_get = getattr(client, "key_value_try_get", None)
+    if try_get is not None:
+        return try_get(key)
+    return client.blocking_key_value_get(key, 50)
+
+
 def _kv_set(prefix: str, value: str) -> None:
     """Best-effort keyed publish under this process's index: a dead KV
     connection must never mask the fault being reported."""
@@ -240,15 +265,28 @@ def publish_stop(dispatched_step: int) -> None:
 
 def gather_stops(timeout_seconds: float) -> Optional[Dict[int, int]]:
     """Collect every host's published stop step; None if a peer never
-    publishes within the timeout (it died before reaching its fence)."""
+    publishes within the timeout (it died before reaching its fence).
+
+    One monotonic deadline bounds the WHOLE gather: granting each peer the
+    full timeout sequentially would let N-1 slow-but-alive peers stretch
+    the fence to (N-1) x timeout while the fast hosts' own peers burn
+    their budgets waiting for a key this host would publish only after —
+    the fence's documented bound is ~2x peer_timeout total, not per peer
+    (ADVICE r5)."""
     client = _kv()
     if client is None:
         return None
+    import time as _time
+
     stops: Dict[int, int] = {}
+    deadline = _time.monotonic() + timeout_seconds
     for p in range(jax.process_count()):
+        remaining_ms = int((deadline - _time.monotonic()) * 1000)
+        if remaining_ms <= 0:
+            return None
         try:
             val = client.blocking_key_value_get(
-                f"{_STOP_PREFIX}{p}", int(timeout_seconds * 1000))
+                f"{_STOP_PREFIX}{p}", remaining_ms)
         except Exception:
             return None
         stops[p] = int(val)
@@ -324,6 +362,81 @@ def watchdog(fn: Callable, timeout_seconds: float,
     if box[1] is not None:
         raise box[1]
     return True, box[0]
+
+
+class PersistentWaiter:
+    """``watchdog`` semantics on ONE long-lived worker thread.
+
+    ``watchdog`` spawns and joins a fresh daemon thread per call; on the
+    per-step metric-consume path that is a thread create/destroy every
+    training step (ADVICE r5). The waiter keeps a single lazily-spawned
+    worker fed through a queue, so the steady-state cost of a bounded wait
+    is an Event handoff. The abandonment contract is ``watchdog``'s: on
+    timeout (or ``poll()`` turning true) the task's ``cancelled`` event is
+    set, ``(False, None)`` is returned, and — because a wedged wait cannot
+    be interrupted — the worker is discarded ALONG WITH its queue; the
+    next ``run`` lazily spawns a fresh one. A discarded worker that later
+    finishes its task sees ``cancelled`` set and exits instead of racing
+    the replacement for new work; its exception, if any, is discarded,
+    exactly as an abandoned ``watchdog`` thread's would be.
+
+    ``run`` serializes callers (one worker, one wait at a time) — the
+    intended user is the training loop's single driver thread.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _worker(tasks: "queue.Queue") -> None:
+        while True:
+            fn, cancelled, box, done = tasks.get()
+            try:
+                box[0] = fn(cancelled)
+            except BaseException as e:  # re-raised in run(), in the caller
+                box[1] = e
+            done.set()
+            if cancelled.is_set():
+                return  # abandoned: a fresh worker owns the successor queue
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._queue = queue.Queue()
+            self._thread = threading.Thread(
+                target=self._worker, args=(self._queue,), daemon=True)
+            self._thread.start()
+
+    def run(self, fn: Callable, timeout_seconds: float,
+            poll: Optional[Callable[[], bool]] = None,
+            poll_seconds: float = 2.0) -> Tuple[bool, object]:
+        import time as _time
+
+        box: list = [None, None]  # [result, exception]
+        cancelled = threading.Event()
+        done = threading.Event()
+        with self._lock:
+            self._ensure_worker()
+            self._queue.put((fn, cancelled, box, done))
+            deadline = _time.monotonic() + timeout_seconds
+            while True:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                done.wait(min(poll_seconds, remaining) if poll else remaining)
+                if done.is_set():
+                    break
+                if poll is not None and poll():
+                    break
+            if not done.is_set():
+                cancelled.set()
+                self._thread = None
+                self._queue = None
+                return False, None
+        if box[1] is not None:
+            raise box[1]
+        return True, box[0]
 
 
 def die_uncoordinated(logger, reason: str) -> None:

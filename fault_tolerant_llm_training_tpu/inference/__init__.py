@@ -1,0 +1,15 @@
+"""TPU-native inference & serving subsystem.
+
+Loads any training checkpoint (checkpoint/manager.py cross-topology restore)
+and serves it through the trained modules themselves: a static-shape GQA
+KV slot cache (kv_cache.py) threaded through ``models/llama.py``'s cached
+forward, jitted prefill/decode steps with an AOT-compiled prefill bucket set
+(engine.py), per-slot seeded sampling (sampler.py), slot-based continuous
+batching (scheduler.py), and a signal-drained lifecycle driver (serve.py)
+that reuses the training stack's ``ft/signals.py`` flags and audit-string
+logging discipline.
+
+Deliberately import-light: ``models/llama.py`` imports ``kv_cache`` for the
+cache write primitive, so this package must not eagerly import the engine
+(which imports the models) back.
+"""

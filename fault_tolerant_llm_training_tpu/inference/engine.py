@@ -1,0 +1,261 @@
+"""Jitted prefill/decode engine over the trained modules.
+
+The engine owns the device state of a serving process: the (possibly
+tensor-parallel) params, the :class:`~.kv_cache.KVCache`, and two compiled
+programs —
+
+- **prefill**: one request's prompt through ``Transformer.forward_with_cache``
+  into a single cache slot (B=1, S=bucket, offset 0), sampling the first
+  generated token from the last prompt position. Prompts are right-padded to
+  a static **bucket** length; the whole bucket set is AOT-compiled at engine
+  build (``jit(...).lower(...).compile()``), so serving never hits a compile
+  stall mid-traffic — the same discipline as the trainer's AOT train step.
+- **decode**: one token for ALL slots at once (B=slots, S=1, per-slot
+  offsets = cache lengths). The cache is donated (``donate_argnums``), so
+  XLA aliases the ring buffers in place.
+
+Checkpoints restore through the existing cross-topology
+``checkpoint/manager.py`` path (:meth:`InferenceEngine.from_checkpoint`):
+the abstract TrainState is rebuilt exactly as the trainer builds it, params
+land sharded on the serving mesh, and scan-form trunks are converted to the
+loop form (``models/llama.py unstack_layer_params``) — the cached forward
+runs the loop trunk only.
+
+Numerics: the cached path reuses the training projections, the same RoPE
+table values at absolute positions, and an attention kernel mirroring
+``xla_attention`` — cached decode logits bit-match the uncached forward
+(tests/test_inference.py).
+"""
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import TransformerConfig
+from ..models.llama import Transformer, unstack_layer_params
+from ..parallel.mesh import use_mesh
+from ..parallel.sharding import param_shardings
+from .kv_cache import KVCache, cache_shardings, init_cache
+from .sampler import sample_token, slot_key
+
+logger = logging.getLogger()
+
+
+def default_prefill_buckets(max_len: int, smallest: int = 16
+                            ) -> Sequence[int]:
+    """Power-of-two bucket ladder up to ``max_len`` (always included): a
+    prompt pays at most 2x its own length in prefill compute, for
+    log2(max_len/smallest) compiled programs."""
+    buckets, b = [], smallest
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=getattr(a, "sharding", None)),
+        tree)
+
+
+class InferenceEngine:
+    """Slot-granular prefill/decode over a trained ``Transformer``.
+
+    ``params`` is the bare 'params' collection of the checkpoint (scan or
+    loop form — scan is converted). Host-side slot bookkeeping (which slot
+    belongs to which request) lives in the scheduler; the engine only moves
+    tensors.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, *, slots: int = 2,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 top_k: int = 0, cache_dtype=None, mesh=None):
+        if cfg.layer_impl == "scan":
+            params = unstack_layer_params(params, cfg.n_layers)
+            cfg = cfg.replace(layer_impl="loop")
+        # remat only pays under grad; serving is forward-only
+        self.cfg = cfg = cfg.replace(remat=False)
+        self.mesh = mesh
+        self.slots = slots
+        self.max_len = max_len or cfg.seq_len
+        self.top_k = top_k
+        self.restored_step: Optional[int] = None
+        buckets = tuple(sorted(set(prefill_buckets
+                                   or default_prefill_buckets(self.max_len))))
+        if buckets[-1] > self.max_len:
+            raise ValueError(f"prefill bucket {buckets[-1]} exceeds "
+                             f"max_len {self.max_len}")
+        self.prefill_buckets = buckets
+        self.model = Transformer(cfg)
+
+        with use_mesh(mesh):
+            shardings = param_shardings(params, mesh)
+            if shardings is not None:
+                params = jax.device_put(params, shardings)
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+            cache = init_cache(cfg, slots, self.max_len, dtype=cache_dtype)
+            cs = cache_shardings(cache, mesh)
+            self.cache = (jax.device_put(cache, cs) if cs is not None
+                          else cache)
+            self._build_programs()
+
+    # --- compiled programs -------------------------------------------------
+
+    def _prefill_fn(self, params, cache, tokens, slot, prompt_len,
+                    temperature, top_p, seed):
+        """(1, bucket) prompt into cache slot ``slot``; returns the updated
+        cache and the first sampled token. Pad positions beyond
+        ``prompt_len`` do get written to the cache, but ``lengths`` masks
+        them out, and decode overwrites each position before attending."""
+        ksl = tuple(jax.lax.dynamic_slice_in_dim(l, slot, 1, 0)
+                    for l in cache.k)
+        vsl = tuple(jax.lax.dynamic_slice_in_dim(l, slot, 1, 0)
+                    for l in cache.v)
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens, ksl, vsl,
+            jnp.zeros((1,), jnp.int32), method="forward_with_cache")
+        k = tuple(jax.lax.dynamic_update_slice_in_dim(l, n, slot, 0)
+                  for l, n in zip(cache.k, nk))
+        v = tuple(jax.lax.dynamic_update_slice_in_dim(l, n, slot, 0)
+                  for l, n in zip(cache.v, nv))
+        lengths = jax.lax.dynamic_update_slice(cache.lengths,
+                                               prompt_len[None], (slot,))
+        last = jax.lax.dynamic_slice_in_dim(
+            logits[0], prompt_len - 1, 1, 0)[0].astype(jnp.float32)
+        tok = sample_token(last, slot_key(seed, jnp.int32(0)),
+                           temperature, top_p, self.top_k)
+        return KVCache(k=k, v=v, lengths=lengths), tok
+
+    def _decode_fn(self, params, cache, tokens, active, temperature, top_p,
+                   seeds, steps):
+        """One token for every slot: feed each slot's last token at its
+        cache length, sample the next. Inactive slots still run (static
+        shapes) but their lengths do not advance, so their repeated write
+        lands on the same masked position and is overwritten at the next
+        prefill."""
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens[:, None], cache.k, cache.v,
+            cache.lengths, method="forward_with_cache")
+        last = logits[:, 0].astype(jnp.float32)
+        keys = jax.vmap(slot_key)(seeds, steps)
+        toks = jax.vmap(sample_token, in_axes=(0, 0, 0, 0, None))(
+            last, keys, temperature, top_p, self.top_k)
+        lengths = cache.lengths + active.astype(jnp.int32)
+        return KVCache(k=nk, v=nv, lengths=lengths), toks
+
+    def _build_programs(self):
+        p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
+        scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
+        scalar_f = jax.ShapeDtypeStruct((), jnp.float32)
+        slots_i = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
+        slots_f = jax.ShapeDtypeStruct((self.slots,), jnp.float32)
+        slots_b = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,)).lower(
+            p_abs, c_abs, slots_i, slots_b, slots_f, slots_f, slots_i,
+            slots_i).compile()
+        self._prefill = {}
+        for b in self.prefill_buckets:
+            tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
+            self._prefill[b] = jax.jit(
+                self._prefill_fn, donate_argnums=(1,)).lower(
+                p_abs, c_abs, tok_abs, scalar_i, scalar_i, scalar_f,
+                scalar_f, scalar_i).compile()
+
+    # --- host API ----------------------------------------------------------
+
+    def prefill(self, slot: int, token_ids, temperature: float = 0.0,
+                top_p: float = 1.0, seed: int = 0) -> int:
+        """Prompt into ``slot``; returns the first generated token id."""
+        ids = np.asarray(token_ids, np.int32).reshape(-1)
+        n = ids.size
+        if not 0 < n <= self.prefill_buckets[-1]:
+            raise ValueError(f"prompt length {n} outside "
+                             f"(0, {self.prefill_buckets[-1]}]")
+        bucket = next(b for b in self.prefill_buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = ids
+        self.cache, tok = self._prefill[bucket](
+            self.params, self.cache, padded, np.int32(slot), np.int32(n),
+            np.float32(temperature), np.float32(top_p), np.int32(seed))
+        return int(tok)
+
+    def decode_step(self, tokens, active, temperature, top_p, seeds, steps
+                    ) -> np.ndarray:
+        """One decode iteration over all slots; host arrays in/out."""
+        self.cache, toks = self._decode(
+            self.params, self.cache,
+            np.asarray(tokens, np.int32), np.asarray(active, bool),
+            np.asarray(temperature, np.float32),
+            np.asarray(top_p, np.float32),
+            np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
+        return np.asarray(toks)
+
+    def reset(self) -> None:
+        """Zero all slot lengths (the buffers' stale contents are masked)."""
+        with use_mesh(self.mesh):
+            cache = init_cache(self.cfg, self.slots, self.max_len,
+                               dtype=self.cache.k[0].dtype)
+            cs = cache_shardings(cache, self.mesh)
+            self.cache = (jax.device_put(cache, cs) if cs is not None
+                          else cache)
+
+    # --- construction from a training checkpoint ---------------------------
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_path: str, job_id: str,
+                        cfg: TransformerConfig, *, step: Optional[int] = None,
+                        mesh=None, **engine_kwargs) -> "InferenceEngine":
+        """Restore a training checkpoint and build an engine on it.
+
+        ``cfg`` must be the architecture the checkpoint was trained with
+        (scan/loop form included — the abstract TrainState has to match the
+        saved tree); the restore itself is the trainer's own cross-topology
+        path, so a checkpoint written on any mesh loads onto this one. The
+        optimizer state is restored alongside (the Composite item layout is
+        fixed) and dropped.
+        """
+        from ..checkpoint.manager import CheckpointManager
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import param_pspecs
+        from ..training.state import TrainState
+        from ..training.step import make_optimizer
+        from jax.sharding import NamedSharding
+
+        model = Transformer(cfg)
+        # only the opt_state TREE matters (restored then dropped); any
+        # schedule yields the same optax.adamw structure
+        optimizer = make_optimizer(1e-4, 1)
+        dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
+
+        def init_fn(key):
+            params = model.init(key, dummy)["params"]
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=optimizer.init(params))
+
+        # Orbax needs target shardings; without a serving mesh, restore onto
+        # a trivial single-device mesh (replicated specs, device 0).
+        restore_mesh = mesh or make_mesh(dp=1, devices=jax.devices()[:1])
+        with use_mesh(restore_mesh):
+            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+            specs = param_pspecs(abstract)
+            abstract = jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype,
+                    sharding=NamedSharding(restore_mesh, s)),
+                abstract, specs,
+                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+            mngr = CheckpointManager(checkpoint_path, job_id,
+                                     enable_async=False)
+            state, _data, restored_step = mngr.restore(abstract, step=step)
+            mngr.close()
+        logger.info("Model loaded from checkpoint")  # ref: train.py:58
+        engine = cls(cfg, state.params, mesh=mesh, **engine_kwargs)
+        engine.restored_step = restored_step
+        return engine

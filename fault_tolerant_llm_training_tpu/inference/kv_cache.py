@@ -1,0 +1,94 @@
+"""Static-shape GQA-aware KV slot cache.
+
+One pair of head-major ring buffers per layer, ``[slots, kv_heads, max_len,
+head_dim]`` — KV heads at their native (grouped) count, mirroring the
+training attention's no-repeat_kv einsum, so the cache is ``n_heads /
+n_kv_heads`` times smaller than a repeated-head layout. ``slots`` is the
+continuous-batching dimension: each slot holds one in-flight request's
+prefix, and the per-slot ``lengths`` vector is both the decode position
+offset and the attention-mask boundary (ops/attention.py
+``cached_attention``).
+
+Everything is a fixed-shape pytree argument (flax ``struct``), NOT a flax
+mutable collection: the jitted decode step takes the cache in and returns it
+out, which lets the engine donate the buffers (jax.jit ``donate_argnums``)
+so XLA updates them in place — no per-token reallocation of the largest
+serving tensor.
+
+Sharding under the training mesh (parallel/mesh.py): ``kv_heads`` rides the
+'tensor' axis exactly like the wk/wv projections that produce it
+(parallel/sharding.py LOGICAL_RULES), slots/positions stay replicated.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.configs import TransformerConfig
+
+
+class KVCache(struct.PyTreeNode):
+    """Per-layer (slots, kv_heads, max_len, head_dim) buffers + fill counts."""
+
+    k: Tuple[jax.Array, ...]  # length n_layers
+    v: Tuple[jax.Array, ...]
+    lengths: jax.Array        # (slots,) int32 tokens written per slot
+
+    @property
+    def slots(self) -> int:
+        return self.k[0].shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.k[0].shape[2]
+
+
+def init_cache(cfg: TransformerConfig, slots: int, max_len: int,
+               dtype=None) -> KVCache:
+    """Zero-filled cache; ``dtype`` defaults to the model's activation dtype
+    (bf16) so cached keys/values are bit-identical to the training forward's."""
+    dtype = cfg.dtype if dtype is None else dtype
+    shape = (slots, cfg.kv_heads, max_len, cfg.head_dim)
+    zeros = tuple(jnp.zeros(shape, dtype) for _ in range(cfg.n_layers))
+    return KVCache(k=zeros, v=tuple(jnp.zeros(shape, dtype)
+                                    for _ in range(cfg.n_layers)),
+                   lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def write_slot_kv(buf: jax.Array, new: jax.Array,
+                  start: jax.Array) -> jax.Array:
+    """Write ``new`` (B, K, S, D) into ``buf`` (B, K, T, D) at each slot's
+    ``start`` (B,) position along the T axis — a vmap'd dynamic_update_slice,
+    so every slot writes at its own offset in one fused XLA op. Callers
+    guarantee ``start + S <= T`` for multi-token (prefill) writes; the
+    single-token decode write always fits (start is taken mod T)."""
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0)))(
+        buf, new, start)
+
+
+def cache_pspec() -> P:
+    """(slots, kv_heads, max_len, head_dim): slots replicated — every device
+    decodes every request, only the heads shard — kv_heads on 'tensor',
+    matching the wk/wv kernels that fill the buffer."""
+    return P(None, "tensor", None, None)
+
+
+def cache_shardings(cache: KVCache, mesh) -> Optional[KVCache]:
+    """NamedSharding pytree for ``cache`` on ``mesh`` (None -> None), with
+    the same divisibility degrade as the param shardings."""
+    if mesh is None:
+        return None
+    from ..parallel.sharding import _fit_spec
+
+    def shard(a):
+        return NamedSharding(mesh, _fit_spec(cache_pspec(), a.shape, mesh))
+
+    return KVCache(
+        k=tuple(shard(a) for a in cache.k),
+        v=tuple(shard(a) for a in cache.v),
+        lengths=NamedSharding(mesh, P(None)),
+    )

@@ -1,0 +1,60 @@
+"""Token sampling: greedy / temperature / top-k / top-p.
+
+All pure functions of (logits, key, knobs) so the engine can fold them into
+the jitted decode step; per-slot determinism comes from the key derivation
+``fold_in(PRNGKey(request_seed), step)`` — restarting a request from its
+prompt replays the identical key sequence, so sampled generations are
+reproducible across engine restarts exactly like greedy ones
+(tests/test_inference.py).
+
+``temperature <= 0`` selects greedy argmax (the scheduler's default), so one
+decode program serves mixed greedy/sampled slots without recompilation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _top_k_filter(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Keep the k highest logits, -inf the rest (static k: part of the
+    compiled program, an engine-level knob rather than a per-request one)."""
+    kth = jax.lax.top_k(logits, top_k)[0][-1]
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def _top_p_filter(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose mass reaches ``top_p`` (always at least the argmax). ``top_p >= 1``
+    keeps everything, so the replicated decode program needs no branch."""
+    sorted_logits = jnp.sort(logits)[::-1]
+    probs = jax.nn.softmax(sorted_logits)
+    cum = jnp.cumsum(probs)
+    # token i is kept iff the mass BEFORE it is < top_p (the crossing token
+    # is included); monotone cum makes this a prefix
+    keep = jnp.sum((cum - probs < top_p).astype(jnp.int32))
+    cutoff = sorted_logits[jnp.maximum(keep - 1, 0)]
+    return jnp.where(logits >= cutoff, logits, -jnp.inf)
+
+
+def sample_token(logits: jnp.ndarray, key: jax.Array,
+                 temperature: jnp.ndarray, top_p: jnp.ndarray,
+                 top_k: int = 0) -> jnp.ndarray:
+    """One next-token id (int32) from unnormalized ``logits`` (V,) fp32.
+
+    temperature/top_p are traced per-slot scalars; top_k is static.
+    Greedy (temperature <= 0) is computed unconditionally and selected with
+    a ``where`` — both paths are cheap relative to the forward, and the
+    single program keeps mixed-slot batches on one compiled decode step.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        scaled = _top_k_filter(scaled, top_k)
+    scaled = _top_p_filter(scaled, top_p)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def slot_key(seed: jnp.ndarray, step: jnp.ndarray) -> jax.Array:
+    """Per-slot, per-step PRNG key: request seed folded by decode step."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), step)

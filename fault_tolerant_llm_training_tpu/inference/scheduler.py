@@ -1,0 +1,202 @@
+"""Slot-based continuous batching (Orca-style, static-shape XLA flavor).
+
+One decode program serves all slots every iteration; requests are admitted
+into free slots *between* decode iterations (no stop-the-world batch
+boundary, the Orca/vLLM scheduling insight) and evicted the moment they hit
+EOS or their token budget — a freed slot is re-filled on the very next
+iteration. All shapes stay static: "admission" is a prefill into one slot of
+the fixed (slots, ...) cache, "eviction" is host bookkeeping plus the mask
+bit in the decode step.
+
+The scheduler is also the drain point for the fault-tolerant serving
+lifecycle: ``stop_admission()`` (serve.py calls it when a SIGUSR1/SIGTERM
+flag fires) freezes the queue while active slots run to completion, so
+in-flight requests finish and queued ones are reported unserved — the
+serving analogue of the trainer's save-on-signal exit policy.
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    id: str
+    prompt: Sequence[int]          # token ids, BOS included by the caller
+    max_new_tokens: int = 32
+    temperature: float = 0.0       # <= 0 -> greedy
+    top_p: float = 1.0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Completion:
+    request_id: str
+    prompt_len: int
+    tokens: List[int]              # generated ids (EOS included if hit)
+    reason: str                    # "eos" | "length"
+    submitted_at: float
+    first_token_at: float
+    finished_at: float
+
+    @property
+    def ttft_seconds(self) -> float:
+        """Time to first token (queue wait + prefill)."""
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_seconds(self) -> float:
+        return self.finished_at - self.submitted_at
+
+    @property
+    def decode_tokens_per_sec(self) -> float:
+        decoded = len(self.tokens) - 1  # first token came from prefill
+        dt = self.finished_at - self.first_token_at
+        return decoded / dt if decoded > 0 and dt > 0 else 0.0
+
+
+class _Slot:
+    def __init__(self, request: Request, first_token: int,
+                 submitted_at: float, now: float):
+        self.request = request
+        self.tokens = [first_token]
+        self.steps = 1  # decode-step counter; prefill consumed step 0
+        self.submitted_at = submitted_at
+        self.first_token_at = now
+
+
+class Scheduler:
+    """Continuous-batching loop over an :class:`~.engine.InferenceEngine`."""
+
+    def __init__(self, engine, eos_token_id: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.eos_token_id = eos_token_id
+        self.clock = clock
+        self.queue: deque = deque()        # (Request, submitted_at)
+        self.active: Dict[int, _Slot] = {}  # slot index -> state
+        self.completed: List[Completion] = []
+        self.admission_open = True
+        self.iterations = 0
+        self.max_concurrent = 0
+        self.step_seconds: List[float] = []  # decode-iteration wall times
+
+    # --- queue management --------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        if len(request.prompt) + request.max_new_tokens > self.engine.max_len:
+            raise ValueError(
+                f"request {request.id}: prompt {len(request.prompt)} + "
+                f"max_new_tokens {request.max_new_tokens} exceeds the "
+                f"cache max_len {self.engine.max_len}")
+        self.queue.append((request, self.clock()))
+
+    def stop_admission(self) -> None:
+        """Drain mode: active slots finish, the queue stays unserved."""
+        self.admission_open = False
+
+    def pending(self) -> bool:
+        return bool(self.active or (self.queue and self.admission_open))
+
+    def unserved(self) -> List[Request]:
+        return [r for r, _ in self.queue]
+
+    # --- one decode iteration ----------------------------------------------
+
+    def _finish(self, slot: int, reason: str, done: List[Completion]) -> None:
+        st = self.active.pop(slot)
+        c = Completion(request_id=st.request.id,
+                       prompt_len=len(st.request.prompt),
+                       tokens=list(st.tokens), reason=reason,
+                       submitted_at=st.submitted_at,
+                       first_token_at=st.first_token_at,
+                       finished_at=self.clock())
+        self.completed.append(c)
+        done.append(c)
+
+    def _admit(self, done: List[Completion]) -> None:
+        free = [s for s in range(self.engine.slots) if s not in self.active]
+        while free and self.queue:
+            req, submitted_at = self.queue.popleft()
+            slot = free.pop(0)
+            first = self.engine.prefill(slot, req.prompt,
+                                        temperature=req.temperature,
+                                        top_p=req.top_p, seed=req.seed)
+            self.active[slot] = _Slot(req, first, submitted_at, self.clock())
+            self.max_concurrent = max(self.max_concurrent, len(self.active))
+            # a request can finish straight out of prefill
+            if self.eos_token_id is not None and first == self.eos_token_id:
+                self._finish(slot, "eos", done)
+            elif req.max_new_tokens <= 1:
+                self._finish(slot, "length", done)
+
+    def step(self) -> List[Completion]:
+        """Admit into free slots, run one decode iteration, evict finished
+        requests. Returns the completions produced by this iteration."""
+        done: List[Completion] = []
+        if self.admission_open:
+            self._admit(done)
+        if not self.active:
+            return done
+        slots = self.engine.slots
+        tokens = np.zeros((slots,), np.int32)
+        active = np.zeros((slots,), bool)
+        temperature = np.zeros((slots,), np.float32)
+        top_p = np.ones((slots,), np.float32)
+        seeds = np.zeros((slots,), np.int32)
+        steps = np.zeros((slots,), np.int32)
+        for s, st in self.active.items():
+            tokens[s] = st.tokens[-1]
+            active[s] = True
+            temperature[s] = st.request.temperature
+            top_p[s] = st.request.top_p
+            seeds[s] = st.request.seed
+            steps[s] = st.steps
+        t0 = self.clock()
+        next_tokens = self.engine.decode_step(tokens, active, temperature,
+                                              top_p, seeds, steps)
+        self.step_seconds.append(self.clock() - t0)
+        self.iterations += 1
+        for s in list(self.active):
+            st = self.active[s]
+            tok = int(next_tokens[s])
+            st.tokens.append(tok)
+            st.steps += 1
+            if self.eos_token_id is not None and tok == self.eos_token_id:
+                self._finish(s, "eos", done)
+            elif len(st.tokens) >= st.request.max_new_tokens:
+                self._finish(s, "length", done)
+        return done
+
+    def run(self, stop: Optional[Callable[[], bool]] = None
+            ) -> List[Completion]:
+        """Drive until idle; ``stop()`` returning True switches to drain
+        mode (finish active, leave the queue). Returns all completions."""
+        while self.pending():
+            if stop is not None and self.admission_open and stop():
+                self.stop_admission()
+            self.step()
+        return self.completed
+
+    # --- aggregate metrics -------------------------------------------------
+
+    def metrics(self) -> dict:
+        lat = np.asarray(self.step_seconds or [0.0])
+        generated = sum(len(c.tokens) for c in self.completed) + sum(
+            len(st.tokens) for st in self.active.values())
+        wall = float(lat.sum())
+        tps = generated / wall if wall > 0 else 0.0
+        return {
+            "iterations": self.iterations,
+            "requests_completed": len(self.completed),
+            "tokens_generated": int(generated),
+            "max_concurrent": self.max_concurrent,
+            "decode_p50_ms": float(np.percentile(lat, 50) * 1e3),
+            "decode_p95_ms": float(np.percentile(lat, 95) * 1e3),
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_slot": tps / max(self.engine.slots, 1),
+        }

@@ -1,0 +1,151 @@
+"""Fault-tolerant serving lifecycle driver.
+
+``python -m fault_tolerant_llm_training_tpu.inference.serve`` restores a
+training checkpoint into the inference engine and drives the
+continuous-batching scheduler, under the SAME signal discipline as training
+(ft/signals.py): the POSIX handler only records SIGUSR1/SIGTERM; the serve
+loop checks the flag between decode iterations and switches to drain mode —
+admission stops, in-flight requests run to completion, queued requests are
+reported unserved — then exits 0 with the ``[EXIT HANDLER]`` audit strings
+(utils/logging.py), so the Slurm pre-warning -> drain -> resubmit pattern the
+trainer uses for checkpoints applies unchanged to serving. Engine build
+(compilation, Orbax restore) runs with signal delivery blocked
+(``flag.deferred()``) for the same native-code EINTR reasons as train.py.
+"""
+
+import argparse
+import sys
+
+from ..data.tokenizer import load_tokenizer
+from ..ft.signals import SignalFlag
+from ..models.configs import get_config
+from ..utils.logging import (
+    AUDIT_REQUEST_DONE_FMT,
+    AUDIT_SERVE_COMPLETED,
+    AUDIT_SERVE_DRAINED_FMT,
+    AUDIT_SERVE_DRAINING_FMT,
+    AUDIT_SERVE_READY_FMT,
+    AUDIT_SERVE_START,
+    AUDIT_SERVE_STEP_FMT,
+    init_logger,
+    logger,
+)
+from .engine import InferenceEngine
+from .scheduler import Request, Scheduler
+
+_DEMO_PROMPT = "alpha bravo charlie delta echo"
+
+
+def get_serve_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="fault_tolerant_llm_training_tpu.inference.serve",
+        description="Serve a training checkpoint with continuous batching "
+                    "and signal-drained shutdown.")
+    p.add_argument("--checkpoint-path", required=True,
+                   help="directory passed to training's --checkpoint-path")
+    p.add_argument("--checkpoint-job-id", required=True,
+                   help="job id the checkpoint was written under "
+                        "(checkpoint_{id}/ subdirectory)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    p.add_argument("--model", default="tiny",
+                   help="model preset the checkpoint was trained with")
+    p.add_argument("--vocab-size", type=int, default=0,
+                   help="0 = take the tokenizer's vocab (training default)")
+    p.add_argument("--tokenizer-name-or-path", default="byte")
+    p.add_argument("--layer-impl", default="loop",
+                   choices=("loop", "scan"),
+                   help="trunk form the checkpoint was trained with "
+                        "(scan checkpoints are converted for decoding)")
+    p.add_argument("--slots", type=int, default=2,
+                   help="concurrent decode slots (continuous batching)")
+    p.add_argument("--max-len", type=int, default=0,
+                   help="KV cache length per slot; 0 = model seq_len")
+    p.add_argument("--prefill-buckets", default="",
+                   help="comma-separated AOT prefill lengths "
+                        "(default: power-of-two ladder)")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompt", action="append", default=[],
+                   help="repeatable; each becomes one request")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="submit the prompt set this many times (load gen)")
+    p.add_argument("--no-eos", action="store_true",
+                   help="ignore EOS; always decode max-new-tokens")
+    p.add_argument("--log-frequency", type=int, default=8)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = get_serve_args(argv)
+    init_logger()
+    flag = SignalFlag()
+    flag.register()  # before engine build, like train.py
+    logger.info(AUDIT_SERVE_START)
+
+    with flag.deferred():  # block delivery across compile + Orbax restore
+        tokenizer = load_tokenizer(args.tokenizer_name_or_path)
+        vocab = args.vocab_size or tokenizer.vocab_size
+        cfg = get_config(args.model, vocab_size=vocab,
+                         layer_impl=args.layer_impl)
+        buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
+                   if args.prefill_buckets else None)
+        engine = InferenceEngine.from_checkpoint(
+            args.checkpoint_path, args.checkpoint_job_id, cfg,
+            step=args.step, slots=args.slots,
+            max_len=args.max_len or None, prefill_buckets=buckets,
+            top_k=args.top_k)
+        logger.info(AUDIT_SERVE_READY_FMT.format(
+            model=args.model, step=engine.restored_step, slots=args.slots))
+        sched = Scheduler(engine,
+                          eos_token_id=(None if args.no_eos
+                                        else tokenizer.eos_token_id))
+        prompts = (args.prompt or [_DEMO_PROMPT]) * args.repeat
+        for i, text in enumerate(prompts):
+            sched.submit(Request(
+                id=f"req{i}", prompt=tokenizer.encode(text),
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_p=args.top_p,
+                seed=args.seed + i))
+
+    drained = False
+    while sched.pending():
+        if flag.signum is not None and sched.admission_open:
+            logger.info(AUDIT_SERVE_DRAINING_FMT.format(
+                signum=flag.signum, active=len(sched.active)))
+            sched.stop_admission()
+            drained = True
+        for c in sched.step():
+            decoded = c.tokens[:-1] if (not args.no_eos and c.reason == "eos"
+                                        ) else c.tokens
+            logger.info(AUDIT_REQUEST_DONE_FMT.format(
+                id=c.request_id, reason=c.reason, prompt_tokens=c.prompt_len,
+                new_tokens=len(c.tokens), ttft_ms=c.ttft_seconds * 1e3,
+                tps=c.decode_tokens_per_sec))
+            logger.info("Request %s output: %r", c.request_id,
+                        tokenizer.decode(decoded))
+        if sched.iterations and sched.iterations % args.log_frequency == 0:
+            logger.info(AUDIT_SERVE_STEP_FMT.format(
+                step=sched.iterations, active=len(sched.active),
+                queued=len(sched.queue), done=len(sched.completed)))
+
+    m = sched.metrics()
+    logger.info("Serving metrics: %d requests | %d tokens | "
+                "%.1f tok/s (%.1f/slot) | decode p50 %.1f ms p95 %.1f ms",
+                m["requests_completed"], m["tokens_generated"],
+                m["tokens_per_sec"], m["tokens_per_sec_per_slot"],
+                m["decode_p50_ms"], m["decode_p95_ms"])
+    if drained:
+        logger.info(AUDIT_SERVE_DRAINED_FMT.format(
+            completed=len(sched.completed), queued=len(sched.queue)))
+    logger.info(AUDIT_SERVE_COMPLETED)
+    # exit 0 always — same contract as training: the exit POLICY is in the
+    # logs, not the return code (nonzero would trip Slurm requeue logic)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

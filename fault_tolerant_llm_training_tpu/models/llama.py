@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops.attention import multihead_attention
+from ..ops.attention import cached_attention, multihead_attention
 from ..ops.rope import (
     apply_rope,
     apply_rope_bhsd,
@@ -135,7 +135,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None):
         cfg = self.cfg
         dh = cfg.head_dim
         nq, nkv = cfg.n_heads * dh, cfg.kv_heads * dh
@@ -155,11 +155,12 @@ class Attention(nn.Module):
             resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
         from ..ops.flash_attention import rope_fused_profitable
         fused_rope_branch = (not ring and resolved == "pallas"
-                             and positions is None
+                             and positions is None and cache is None
                              and cfg.rope_impl == "fused"
                              and rope_fused_profitable(s, dh))
         bhsd_branch = (not fused_rope_branch and not ring
                        and resolved == "pallas" and positions is None
+                       and cache is None
                        and cfg.qkv_layout == "bhsd")
         head_major = None  # (qt, kt, vt) in (B, H, S, D) when qkv_einsum
         if cfg.qkv_einsum and (fused_rope_branch or bhsd_branch):
@@ -201,6 +202,34 @@ class Attention(nn.Module):
                 b, s, cfg.kv_heads, dh)
             v = nn.Dense(nkv, name="wv", **dense)(x).reshape(
                 b, s, cfg.kv_heads, dh)
+
+        if cache is not None:
+            # Prefill/decode against a per-slot KV ring buffer: q/k/v come
+            # from the SAME projection impl the training forward selects
+            # (fused_qkv or Dense — the fused_rope/bhsd branches are gated
+            # off above, so canonical q/k/v always exist here), RoPE gathers
+            # from the same precomputed table at absolute positions, and the
+            # einsum attention mirrors xla_attention's numerics — cached
+            # decode logits bit-match the uncached forward
+            # (tests/test_inference.py).
+            from ..inference.kv_cache import write_slot_kv
+            k_cache, v_cache, offsets = cache
+            t = k_cache.shape[2]
+            cos, sin = precompute_rope(dh, t, cfg.rope_theta)
+            pos = offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+            q = apply_rope(q, cos, sin, positions=pos)
+            k = apply_rope(k, cos, sin, positions=pos)
+            # Write the rotated keys/values head-major at each slot's next
+            # position (mod T: the ring wraps per slot) BEFORE attending, so
+            # the new tokens attend to themselves through the cache.
+            k_cache = write_slot_kv(k_cache, jnp.transpose(k, (0, 2, 1, 3)),
+                                    offsets % t)
+            v_cache = write_slot_kv(v_cache, jnp.transpose(v, (0, 2, 1, 3)),
+                                    offsets % t)
+            out = cached_attention(q, k_cache, v_cache, offsets)
+            out = out.reshape(b, s, cfg.n_heads * dh)
+            return (nn.Dense(cfg.dim, name="wo", **dense)(out),
+                    (k_cache, v_cache))
 
         if fused_rope_branch:
             # RoPE inside the kernels (ops/flash_attention.py
@@ -307,11 +336,17 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None):
         cfg = self.cfg
-        h = x + Attention(cfg, name="attention")(
-            RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
-            positions)
+        normed = RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype,
+                         name="attention_norm")(x)
+        attn = Attention(cfg, name="attention")
+        new_cache = None
+        if cache is not None:
+            attn_out, new_cache = attn(normed, positions, cache)
+        else:
+            attn_out = attn(normed, positions)
+        h = x + attn_out
         h = constrain(h, "batch", "seq", "act_embed")
         if cfg.moe_experts:
             from .moe import MoEFeedForward
@@ -320,7 +355,8 @@ class TransformerBlock(nn.Module):
             ffn = FeedForward(cfg, name="feed_forward")
         out = h + ffn(
             RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h))
-        return constrain(out, "batch", "seq", "act_embed")
+        out = constrain(out, "batch", "seq", "act_embed")
+        return out if cache is None else (out, new_cache)
 
 
 class _ScanBlock(nn.Module):
@@ -420,6 +456,28 @@ class Transformer(nn.Module):
     def __call__(self, tokens, positions=None):
         logits = self.output(self.hidden_states(tokens, positions))
         return constrain(logits, "batch", "seq", "vocab")
+
+    def forward_with_cache(self, tokens, cache_k, cache_v, offsets):
+        """Prefill/decode forward through per-layer KV slot buffers.
+
+        ``tokens`` (B, S) occupy absolute positions ``offsets[b] + [0, S)``;
+        each layer attends against (and appends to) its (B, K, T, D) buffers
+        from ``cache_k``/``cache_v`` (length-n_layers sequences). Loop trunk
+        only — the inference engine converts scan-form checkpoints with
+        :func:`unstack_layer_params`. Returns
+        ``(logits, (new_cache_k, new_cache_v))``.
+        """
+        if self.cfg.layer_impl != "loop":
+            raise ValueError(
+                "forward_with_cache requires layer_impl='loop'; convert "
+                "scan-form checkpoints with unstack_layer_params")
+        x = self.embed(tokens)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            x, (k_i, v_i) = layer(x, None, (cache_k[i], cache_v[i], offsets))
+            new_k.append(k_i)
+            new_v.append(v_i)
+        return self.head(x), (tuple(new_k), tuple(new_v))
 
 
 def stack_layer_params(params: dict, n_layers: int) -> dict:

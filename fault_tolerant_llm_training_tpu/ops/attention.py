@@ -55,6 +55,43 @@ def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(b, s_q, h, d).astype(q.dtype)
 
 
+def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                     offsets: jnp.ndarray) -> jnp.ndarray:
+    """Grouped-query attention against a per-slot KV cache (prefill/decode).
+
+    q:                (B, S, H, D) — S new queries per slot at absolute
+                      positions ``offsets[b] + [0, S)``.
+    k_cache, v_cache: (B, K, T, D) head-major slot buffers; positions
+                      ``[0, offsets[b] + S)`` must already hold this slot's
+                      rotated keys/values (the caller writes before calling).
+    offsets:          (B,) int32 tokens previously in each slot's cache.
+
+    Numerics mirror :func:`xla_attention` exactly — same grouped einsum
+    contraction, fp32 scores, additive ``finfo.min`` mask, fp32 softmax cast
+    back to q.dtype, fp32 output accumulation — so a cached decode reproduces
+    the full-forward logits bit-for-bit: masked positions (the cache tail
+    beyond a slot's length) get ``exp(min) == 0`` probability exactly, and
+    zero probabilities contribute exact zeros to the fp32 accumulation.
+    """
+    b, s_q, h, d = q.shape
+    _, kv, t, _ = k_cache.shape
+    g = h // kv
+    qg = q.reshape(b, s_q, kv, g, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    # scores: (B, K, G, S_q, T)
+    scores = jnp.einsum("bqkgd,bktd->bkgqt", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = offsets[:, None] + jnp.arange(s_q)[None, :]          # (B, S_q)
+    k_pos = jnp.arange(t)[None, None, :]                         # (1, 1, T)
+    mask = jnp.where(k_pos <= q_pos[:, :, None], 0.0,
+                     jnp.finfo(jnp.float32).min)                 # (B, S_q, T)
+    scores = scores + mask[:, None, None, :, :]
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqt,bktd->bqkgd", probs, v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s_q, h, d).astype(q.dtype)
+
+
 def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         impl: str = "auto", causal: bool = True) -> jnp.ndarray:
     """Dispatch to the requested attention implementation."""

@@ -60,10 +60,7 @@ from .ring_flash import (
     fresh_carry,
 )
 
-try:  # jax >= 0.6 exposes shard_map at the top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ..utils.jax_compat import shard_map as _shard_map
 
 NEG_INF = -1e30
 

@@ -77,6 +77,10 @@ class Trainer:
         self._inflight = collections.deque()
         self._batch_iter = None  # live prefetch iterator (fence catch-up)
         self._in_guard = False  # re-entrancy latch for _guarded_wait
+        # One long-lived bounded-wait worker: _guarded_wait runs every
+        # training step (metric consume), so per-call thread spawn/join
+        # (watchdog) would churn a thread per step (ADVICE r5).
+        self._waiter = multihost.PersistentWaiter()
         self._fence_done = False  # fence ran; stale err keys must not re-raise
         self._signal_round = 0  # KV signal-agreement round (sync boundaries)
         self._est_save_seconds = None  # startup write-probe estimate
@@ -104,10 +108,15 @@ class Trainer:
                     f"{explicit}; missing "
                     f"{sorted(set(explicit) - set(present))}")
             if present:
+                # Explicit config must also disable cluster sniffing:
+                # jax's Slurm detector triggers on SLURM_JOB_ID alone (set
+                # for checkpoint naming even off-Slurm) and then dies on
+                # the missing SLURM_LOCALID.
                 kwargs = dict(
                     coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
                     num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
-                    process_id=int(os.environ["JAX_PROCESS_ID"]))
+                    process_id=int(os.environ["JAX_PROCESS_ID"]),
+                    cluster_detection_method="deactivate")
             jax.distributed.initialize(**kwargs)
         # Multihost: in-loop signal checks are cluster-wide agreements
         # (ft/multihost.py) so all hosts raise at the same boundary; setup
@@ -602,13 +611,15 @@ class Trainer:
         coordinated save; no announcement means the peer is dead (SIGKILL,
         node loss) — degrade to a clean no-save exit instead of hanging
         until the scheduler shoots this host too. Single-process (and
-        re-entrant) calls run ``fn`` directly."""
+        re-entrant) calls run ``fn`` directly. Runs on the persistent
+        waiter — this is the per-step path, and a fresh watchdog thread
+        per step is pure churn."""
         if not self._sync_signals or self._in_guard:
             return fn(_NEVER_CANCELLED)  # direct execution
         self._in_guard = True
         try:
-            ok, result = multihost.watchdog(fn,
-                                            self.cfg.peer_timeout_seconds)
+            ok, result = self._waiter.run(fn,
+                                          self.cfg.peer_timeout_seconds)
         finally:
             self._in_guard = False
         if ok:

@@ -1,0 +1,34 @@
+"""Version shims for jax APIs that moved between 0.4.x and 0.6+.
+
+The build targets current jax (top-level ``jax.shard_map`` with
+``axis_names=``/``check_vma=``); CI containers may carry a 0.4.x jaxlib
+whose ``jax.experimental.shard_map`` spells the same partial-manual
+contract as ``auto=`` (the COMPLEMENT of the manual axes) and
+``check_rep=``. Everything else (mesh/in_specs/out_specs) is identical,
+so one thin adapter keeps the call sites on the modern spelling.
+"""
+
+from typing import Optional, Set
+
+import jax
+
+try:  # jax >= 0.6 exposes shard_map at the top level
+    from jax import shard_map as _new_shard_map
+except ImportError:  # pragma: no cover
+    _new_shard_map = None
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[Set] = None, check_vma: bool = True):
+    """``jax.shard_map`` with the modern keywords on any supported jax."""
+    if _new_shard_map is not None:
+        kwargs = {} if axis_names is None else {"axis_names": axis_names}
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma,
+                              **kwargs)
+    auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+            if axis_names is not None else frozenset())
+    return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma,
+                          auto=auto)

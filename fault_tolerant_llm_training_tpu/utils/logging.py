@@ -50,3 +50,22 @@ AUDIT_START = "Starting training!"
 AUDIT_COMPLETED = "Training completed"
 # ref: train.py:116 (formatted)
 AUDIT_STEP_FMT = "Training step: {step} | Loss: {loss:.2f}"
+
+# --- Serving audit strings (inference/serve.py) — same grep-the-.out-file
+# discipline as the training trail: the drain lifecycle is asserted by
+# tests/test_inference.py exactly like the exit-handler strings above. ---
+AUDIT_SERVE_START = "Starting serving!"
+AUDIT_SERVE_READY_FMT = ("Serving ready | model {model} | checkpoint step "
+                         "{step} | slots {slots}")
+AUDIT_SERVE_STEP_FMT = ("Serve step: {step} | Active: {active} | "
+                        "Queued: {queued} | Done: {done}")
+AUDIT_SERVE_DRAINING_FMT = ("[EXIT HANDLER] Signal {signum} received, "
+                            "draining {active} in-flight request(s), "
+                            "admission stopped.")
+AUDIT_SERVE_DRAINED_FMT = ("[EXIT HANDLER] Drained; {completed} request(s) "
+                           "completed, {queued} queued request(s) not "
+                           "admitted.")
+AUDIT_REQUEST_DONE_FMT = ("Request {id} done | {reason} | prompt "
+                          "{prompt_tokens} tok | generated {new_tokens} tok "
+                          "| ttft {ttft_ms:.0f} ms | {tps:.1f} tok/s")
+AUDIT_SERVE_COMPLETED = "Serving completed"

@@ -1,0 +1,124 @@
+"""Continuous-batching decode throughput/latency bench (inference/).
+
+Builds an InferenceEngine (random params by default, or a real checkpoint
+via --checkpoint-path/--checkpoint-job-id), drives the scheduler with
+synthetic concurrent requests, and writes BENCH_decode_<model>_<backend>.json
+with the serving headline numbers: tokens/sec, tokens/sec/slot, and p50/p95
+per-decode-iteration latency.
+
+Run on the chip:  python scripts/decode_bench.py --model tiny --slots 8
+CPU smoke:        JAX_PLATFORMS=cpu python scripts/decode_bench.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--vocab-size", type=int, default=0)
+    p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=0)
+    p.add_argument("--prompt-len", type=int, default=32)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--warmup-requests", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-path", default="")
+    p.add_argument("--checkpoint-job-id", default="")
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.data.tokenizer import load_tokenizer
+    from fault_tolerant_llm_training_tpu.inference.engine import InferenceEngine
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request,
+        Scheduler,
+    )
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    vocab = args.vocab_size or load_tokenizer("byte").vocab_size
+    cfg = get_config(args.model, vocab_size=vocab,
+                     layer_impl=args.layer_impl)
+    max_len = args.max_len or min(cfg.seq_len,
+                                  args.prompt_len + args.max_new_tokens)
+
+    t0 = time.monotonic()
+    if args.checkpoint_path:
+        engine = InferenceEngine.from_checkpoint(
+            args.checkpoint_path, args.checkpoint_job_id, cfg,
+            slots=args.slots, max_len=max_len)
+    else:
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(args.seed),
+                            jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+        engine = InferenceEngine(cfg, params, slots=args.slots,
+                                 max_len=max_len)
+    build_seconds = time.monotonic() - t0
+
+    rng = np.random.default_rng(args.seed)
+
+    def _requests(n, tag):
+        return [Request(id=f"{tag}{i}",
+                        prompt=rng.integers(3, vocab,
+                                            size=args.prompt_len).tolist(),
+                        max_new_tokens=args.max_new_tokens)
+                for i in range(n)]
+
+    # warmup: touch every prefill bucket/decode program once off the clock
+    warm = Scheduler(engine, eos_token_id=None)
+    for r in _requests(max(args.warmup_requests, 1), "warm"):
+        warm.submit(r)
+    warm.run()
+    engine.reset()
+
+    sched = Scheduler(engine, eos_token_id=None)
+    for r in _requests(args.requests, "req"):
+        sched.submit(r)
+    t0 = time.monotonic()
+    sched.run()
+    wall = time.monotonic() - t0
+    m = sched.metrics()
+
+    backend = jax.default_backend()
+    result = {
+        "metric": (f"decode tokens/sec/slot ({args.model}, {args.slots} "
+                   f"slots, prompt {args.prompt_len}, gen "
+                   f"{args.max_new_tokens}, backend {backend})"),
+        "value": round(m["tokens_per_sec_per_slot"], 1),
+        "unit": "tokens/sec/slot",
+        "tokens_per_sec": round(m["tokens_per_sec"], 1),
+        "decode_p50_ms": round(m["decode_p50_ms"], 3),
+        "decode_p95_ms": round(m["decode_p95_ms"], 3),
+        "requests": m["requests_completed"],
+        "tokens_generated": m["tokens_generated"],
+        "max_concurrent": m["max_concurrent"],
+        "iterations": m["iterations"],
+        "wall_seconds": round(wall, 3),
+        "engine_build_seconds": round(build_seconds, 3),
+        "restored_step": engine.restored_step,
+    }
+    print(json.dumps(result))
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f"BENCH_decode_{args.model}_{backend}.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

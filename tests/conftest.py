@@ -10,6 +10,8 @@ subprocesses spawned by tests inherit the env vars and stay hermetic too.
 """
 
 import os
+import subprocess
+import sys
 
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -18,14 +20,47 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # in-process stuck detector would abort the run (seen on the flagship-8B
 # test: minutes of single-core RNG/GEMM between peers). Shared with the
 # subprocess harness in test_fault_tolerance.py.
-COLLECTIVE_TIMEOUT_FLAGS = (
+_COLLECTIVE_TIMEOUT_FLAGS = (
     "--xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
     " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
+
+
+def _probe_collective_timeout_flags() -> str:
+    """XLA treats unknown XLA_FLAGS as a CHECK-failure at backend init
+    (parse_flags_from_env.cc aborts the process, not a warning), and the
+    collective stuck-detector flags above only exist in newer jaxlibs. On an
+    older jaxlib the first test to touch a device would kill the *entire*
+    pytest session. Probe once per jaxlib version in a throwaway subprocess
+    and drop the flags when unsupported."""
+    import jaxlib
+
+    cache = f"/tmp/_ftl_xla_collective_flag_probe_{jaxlib.__version__}"
+    try:
+        with open(cache) as f:
+            return _COLLECTIVE_TIMEOUT_FLAGS if f.read() == "1" else ""
+    except OSError:
+        pass
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=_COLLECTIVE_TIMEOUT_FLAGS)
+    ok = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        timeout=120).returncode == 0
+    try:
+        with open(cache, "w") as f:
+            f.write("1" if ok else "0")
+    except OSError:
+        pass
+    return _COLLECTIVE_TIMEOUT_FLAGS if ok else ""
+
+
+COLLECTIVE_TIMEOUT_FLAGS = _probe_collective_timeout_flags()
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-if "xla_cpu_collective_call_warn_stuck" not in flags:
+if COLLECTIVE_TIMEOUT_FLAGS and "xla_cpu_collective_call_warn_stuck" not in flags:
     flags += " " + COLLECTIVE_TIMEOUT_FLAGS
 os.environ["XLA_FLAGS"] = flags
 
@@ -39,6 +74,83 @@ jax.config.update("jax_platforms", "cpu")
 # Numerics tests compare against fp64/fp32 oracles; JAX's *default* matmul
 # precision truncates to bf16-class even on CPU in this build.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+_MP_PROBE_WORKER = """
+import os, sys
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+os.environ.pop('XLA_FLAGS', None)
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.distributed.initialize(sys.argv[2], num_processes=2,
+                           process_id=int(sys.argv[1]))
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices('probe')  # cross-process XLA collective
+print('MP_OK', flush=True)
+os._exit(0)  # skip jax.distributed.shutdown: its barrier can stall atexit
+"""
+
+
+def _probe_multiprocess_cpu_jit() -> bool:
+    """The multi-host pod tests run real 2-process jax.distributed clusters
+    on the CPU backend. Some jaxlibs cannot execute multiprocess XLA
+    computations on CPU at all — one process raises 'Multiprocess
+    computations aren't implemented on the CPU backend' while its peer
+    WEDGES inside the collective (and then the shutdown barrier burns its
+    full 5-minute timeout). Each pod test would then eat its entire
+    subprocess timeout x3 retries, starving the rest of the suite. Probe
+    the exact failing op (a cross-process sync) once per jaxlib version in
+    throwaway subprocesses and let the pod tests skip when it can't run."""
+    import socket
+    import time
+
+    import jaxlib
+
+    cache = f"/tmp/_ftl_multiprocess_cpu_probe_{jaxlib.__version__}"
+    try:
+        with open(cache) as f:
+            return f.read() == "1"
+    except OSError:
+        pass
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _MP_PROBE_WORKER, str(i), coord],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+        for i in range(2)]
+    deadline = time.monotonic() + 90
+    ok = True
+    for p in procs:
+        try:
+            rc = p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            ok = ok and rc == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+    for p in procs:
+        if p.poll() is None:
+            p.kill()  # a wedged collective ignores SIGTERM
+            p.wait()
+    try:
+        with open(cache, "w") as f:
+            f.write("1" if ok else "0")
+    except OSError:
+        pass
+    return ok
+
+
+@pytest.fixture(scope="session")
+def multiprocess_cpu_jit():
+    """Pod tests that jit XLA computations across a real 2-process CPU
+    cluster declare this fixture; it skips them on jaxlibs whose CPU
+    backend cannot run multiprocess programs (see the probe above)."""
+    if not _probe_multiprocess_cpu_jit():
+        pytest.skip("this jaxlib's CPU backend cannot execute multiprocess "
+                    "XLA computations (capability probe failed)")
 
 
 @pytest.fixture(scope="session")
