@@ -29,6 +29,8 @@ import jax
 
 from .multihost import PeerHostError
 
+from ..obs import events
+from ..obs.goodput import failure_class
 from ..utils.config import JOBID, WORKDIR
 from ..utils.logging import (
     AUDIT_CANCELLED,
@@ -63,23 +65,41 @@ def resubmit(logger, command: str = "") -> bool:
     cmd = command or f"sbatch {WORKDIR}/train.sh {JOBID}"
     ret = os.system(cmd)
     if ret != 0:
-        logger.info(AUDIT_REQUEUE_FAILED_FMT.format(job_id=JOBID))
+        events.emit_audit(logger,
+                          AUDIT_REQUEUE_FAILED_FMT.format(job_id=JOBID),
+                          "requeue", ok=False)
         return False
-    logger.info(AUDIT_REQUEUED)
+    events.emit_audit(logger, AUDIT_REQUEUED, "requeue", ok=True)
     return True
 
 
 def handle_exit(trainer, error_type: int, logger) -> None:
     """Policy dispatch (ref: utils.py:65-90). ``trainer`` may be None or
-    partially constructed (signal during setup)."""
+    partially constructed (signal during setup).
+
+    Every branch both logs the byte-identical audit string AND emits the
+    structured event (obs/events.py) the goodput stitcher reads; the
+    ``finally`` flush is the flight-recorder guarantee — the event log is
+    durable on every exit path, including a save that itself dies."""
+    try:
+        _handle_exit(trainer, error_type, logger)
+    finally:
+        events.flush()
+
+
+def _handle_exit(trainer, error_type: int, logger) -> None:
+    cls = failure_class(error_type)
     if error_type == SIGNAL_CANCEL:
-        logger.info(AUDIT_CANCELLED)
+        events.emit_audit(logger, AUDIT_CANCELLED, "exit",
+                          error_type=error_type, cls=cls, saved=False)
         return
     if error_type in (CODE_ERROR, SIGNAL_TIMEOUT):
         if error_type == SIGNAL_TIMEOUT:
-            logger.info(AUDIT_TIMEOUT_SAVING)
+            events.emit_audit(logger, AUDIT_TIMEOUT_SAVING, "signal",
+                              signum=error_type, cls=cls)
         else:
-            logger.info(AUDIT_ERROR_SAVING)
+            events.emit_audit(logger, AUDIT_ERROR_SAVING, "signal",
+                              signum=error_type, cls=cls)
         saved_step = None
         if trainer is not None and getattr(trainer, "state", None) is not None:
             # Coordination: signal exits were agreed cluster-wide
@@ -116,13 +136,18 @@ def handle_exit(trainer, error_type: int, logger) -> None:
                 saved_step = trainer.save_checkpoint(wait=True,
                                                      coordinated=True,
                                                      fault=True)
-            logger.info(AUDIT_SAVED_FMT.format(step=saved_step))
+            events.emit_audit(logger, AUDIT_SAVED_FMT.format(step=saved_step),
+                              "exit", step=saved_step, error_type=error_type,
+                              cls=cls, saved=True, saved_step=saved_step)
         else:
             logger.info("[EXIT HANDLER] No training state to save yet.")
+            events.emit(kind="exit", error_type=error_type, cls=cls,
+                        saved=False, no_state=True)
         if error_type == SIGNAL_TIMEOUT:
             command = ""
             if trainer is not None:
                 command = trainer.cfg.resubmit_command
             resubmit(logger, command)
         return
-    logger.info(AUDIT_UNKNOWN_FMT.format(type=error_type))
+    events.emit_audit(logger, AUDIT_UNKNOWN_FMT.format(type=error_type),
+                      "exit", error_type=error_type, cls=cls, saved=False)

@@ -75,6 +75,11 @@ _DEAD_PREFIX = "ftl_fault/dead/"
 # monotonic counter — "oneshot" cannot collide with the integer round ids.
 _SIG_PREFIX = "ftl_sig/"
 _ONESHOT_ROUNDS = itertools.count()
+# Heartbeats (obs/prometheus.py): ftl_hb/<proc> = "<unix time>:<step>",
+# overwritten in place each interval (unlike the fault keys, which are
+# one-incident write-once).
+_HB_PREFIX = "ftl_hb/"
+_LOCAL_HEARTBEAT: Dict[int, Tuple[float, int]] = {}  # single-process mirror
 
 # Audit line for the degraded (dead-peer) exit; tests and operators grep it.
 AUDIT_UNCOORDINATED_FMT = ("[EXIT HANDLER] Pod fault fence failed ({reason}); "
@@ -302,6 +307,51 @@ def publish_dead() -> None:
     _kv_set(_DEAD_PREFIX, "1")
 
 
+def publish_heartbeat(step: int) -> None:
+    """Publish ``(now, step)`` under this host's heartbeat key. Heartbeat
+    keys are the one KV surface that is overwritten in place: newer jaxlibs
+    take ``allow_overwrite``; older ones need a delete-then-set (both
+    best-effort — a flaky KV channel must never take down training)."""
+    import time as _time
+
+    value = f"{_time.time():.3f}:{int(step)}"
+    client = _kv()
+    if client is None:
+        _LOCAL_HEARTBEAT[0] = (_time.time(), int(step))
+        return
+    key = f"{_HB_PREFIX}{jax.process_index()}"
+    try:
+        try:
+            client.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:  # jaxlib without the kwarg
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass
+            client.key_value_set(key, value)
+    except Exception:
+        pass
+
+
+def read_heartbeats() -> Dict[int, Tuple[float, int]]:
+    """Every host's last published heartbeat: {process -> (unix time,
+    step)}. Hosts that never published are absent — a host missing from the
+    map after startup is as alarming as a stale one. Single-process runs
+    return the local mirror so the metric surface is identical off-pod."""
+    client = _kv()
+    if client is None:
+        return dict(_LOCAL_HEARTBEAT)
+    beats: Dict[int, Tuple[float, int]] = {}
+    for p in range(jax.process_count()):
+        try:
+            raw = _kv_try_get(client, f"{_HB_PREFIX}{p}")
+            t, step = raw.split(":")
+            beats[p] = (float(t), int(step))
+        except Exception:
+            continue  # not published yet (or torn mid-overwrite)
+    return beats
+
+
 def peer_dead_pending() -> bool:
     client = _kv()
     if client is None:
@@ -450,7 +500,12 @@ def die_uncoordinated(logger, reason: str) -> None:
     import logging
 
     try:
-        logger.info(AUDIT_UNCOORDINATED_FMT.format(reason=reason))
+        from ..obs import events
+
+        events.emit_audit(logger,
+                          AUDIT_UNCOORDINATED_FMT.format(reason=reason),
+                          "exit", degraded=True, reason=reason)
+        events.flush()  # the .out file dies with the node; the JSONL lives
         logging.shutdown()  # flush the pipe before the hard exit
     except Exception:
         pass

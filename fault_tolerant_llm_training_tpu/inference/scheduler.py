@@ -22,6 +22,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.registry import MetricRegistry, default_registry
+
 
 @dataclasses.dataclass
 class Request:
@@ -73,7 +75,8 @@ class Scheduler:
     """Continuous-batching loop over an :class:`~.engine.InferenceEngine`."""
 
     def __init__(self, engine, eos_token_id: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricRegistry] = None):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -84,6 +87,27 @@ class Scheduler:
         self.iterations = 0
         self.max_concurrent = 0
         self.step_seconds: List[float] = []  # decode-iteration wall times
+        # /metrics surface (obs/registry.py): serve.py --metrics-port scrapes
+        # these live while the batching loop runs.
+        r = registry or default_registry()
+        self._m_ttft = r.histogram(
+            "ftl_serve_ttft_seconds",
+            "Time to first token (queue wait + prefill) per request")
+        self._m_decode = r.histogram(
+            "ftl_serve_decode_step_seconds",
+            "Wall time of one batched decode iteration")
+        self._m_tokens = r.counter("ftl_serve_tokens_generated_total",
+                                   "Tokens generated across all requests")
+        self._m_done = r.counter(
+            "ftl_serve_requests_completed_total",
+            "Requests completed, by finish reason (eos|length)")
+        self._m_occupancy = r.gauge(
+            "ftl_serve_slot_occupancy",
+            "Active decode slots / total slots (0-1)")
+        self._m_queue = r.gauge("ftl_serve_queue_depth",
+                                "Requests waiting for a free slot")
+        self._m_tps = r.gauge("ftl_serve_tokens_per_sec",
+                              "Aggregate decode throughput (running)")
 
     # --- queue management --------------------------------------------------
 
@@ -117,6 +141,8 @@ class Scheduler:
                        finished_at=self.clock())
         self.completed.append(c)
         done.append(c)
+        self._m_ttft.observe(c.ttft_seconds)
+        self._m_done.labels(reason=reason).inc()
 
     def _admit(self, done: List[Completion]) -> None:
         free = [s for s in range(self.engine.slots) if s not in self.active]
@@ -128,6 +154,7 @@ class Scheduler:
                                         top_p=req.top_p, seed=req.seed)
             self.active[slot] = _Slot(req, first, submitted_at, self.clock())
             self.max_concurrent = max(self.max_concurrent, len(self.active))
+            self._m_tokens.inc()  # the prefill's first token
             # a request can finish straight out of prefill
             if self.eos_token_id is not None and first == self.eos_token_id:
                 self._finish(slot, "eos", done)
@@ -140,6 +167,8 @@ class Scheduler:
         done: List[Completion] = []
         if self.admission_open:
             self._admit(done)
+        self._m_queue.set(len(self.queue))
+        self._m_occupancy.set(len(self.active) / max(self.engine.slots, 1))
         if not self.active:
             return done
         slots = self.engine.slots
@@ -159,13 +188,19 @@ class Scheduler:
         t0 = self.clock()
         next_tokens = self.engine.decode_step(tokens, active, temperature,
                                               top_p, seeds, steps)
-        self.step_seconds.append(self.clock() - t0)
+        step_wall = self.clock() - t0
+        self.step_seconds.append(step_wall)
+        self._m_decode.observe(step_wall)
+        wall = sum(self.step_seconds)
+        if wall > 0:
+            self._m_tps.set(self._m_tokens.value / wall)
         self.iterations += 1
         for s in list(self.active):
             st = self.active[s]
             tok = int(next_tokens[s])
             st.tokens.append(tok)
             st.steps += 1
+            self._m_tokens.inc()
             if self.eos_token_id is not None and tok == self.eos_token_id:
                 self._finish(s, "eos", done)
             elif len(st.tokens) >= st.request.max_new_tokens:
@@ -190,6 +225,7 @@ class Scheduler:
             len(st.tokens) for st in self.active.values())
         wall = float(lat.sum())
         tps = generated / wall if wall > 0 else 0.0
+        self._m_tps.set(tps)
         return {
             "iterations": self.iterations,
             "requests_completed": len(self.completed),

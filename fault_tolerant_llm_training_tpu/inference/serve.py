@@ -14,11 +14,15 @@ trainer uses for checkpoints applies unchanged to serving. Engine build
 """
 
 import argparse
+import os
 import sys
 
 from ..data.tokenizer import load_tokenizer
 from ..ft.signals import SignalFlag
 from ..models.configs import get_config
+from ..obs import events
+from ..obs.prometheus import MetricsServer
+from ..utils.config import JOBID
 from ..utils.logging import (
     AUDIT_REQUEST_DONE_FMT,
     AUDIT_SERVE_COMPLETED,
@@ -76,6 +80,11 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no-eos", action="store_true",
                    help="ignore EOS; always decode max-new-tokens")
     p.add_argument("--log-frequency", type=int, default=8)
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus /metrics on this port "
+                        "(0 = disabled); TTFT, decode-step, slot occupancy")
+    p.add_argument("--event-log", default="",
+                   help="flight-recorder JSONL path ('' = disabled)")
     return p.parse_args(argv)
 
 
@@ -84,7 +93,15 @@ def main(argv=None) -> None:
     init_logger()
     flag = SignalFlag()
     flag.register()  # before engine build, like train.py
-    logger.info(AUDIT_SERVE_START)
+    if args.event_log:
+        events.configure(args.event_log, job=JOBID or "serve",
+                         host=os.getpid())
+    metrics_server = None
+    if args.metrics_port:
+        metrics_server = MetricsServer(port=args.metrics_port)
+        port = metrics_server.start()
+        logger.info(f"Metrics | serving /metrics on port {port}")
+    events.emit_audit(logger, AUDIT_SERVE_START, "start")
 
     with flag.deferred():  # block delivery across compile + Orbax restore
         tokenizer = load_tokenizer(args.tokenizer_name_or_path)
@@ -98,8 +115,12 @@ def main(argv=None) -> None:
             step=args.step, slots=args.slots,
             max_len=args.max_len or None, prefill_buckets=buckets,
             top_k=args.top_k)
-        logger.info(AUDIT_SERVE_READY_FMT.format(
-            model=args.model, step=engine.restored_step, slots=args.slots))
+        events.emit_audit(
+            logger, AUDIT_SERVE_READY_FMT.format(
+                model=args.model, step=engine.restored_step,
+                slots=args.slots),
+            "ready", step=engine.restored_step, slots=args.slots,
+            model=args.model)
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id))
@@ -114,23 +135,33 @@ def main(argv=None) -> None:
     drained = False
     while sched.pending():
         if flag.signum is not None and sched.admission_open:
-            logger.info(AUDIT_SERVE_DRAINING_FMT.format(
-                signum=flag.signum, active=len(sched.active)))
+            events.emit_audit(
+                logger, AUDIT_SERVE_DRAINING_FMT.format(
+                    signum=flag.signum, active=len(sched.active)),
+                "drain", phase="begin", signum=flag.signum,
+                active=len(sched.active))
             sched.stop_admission()
             drained = True
         for c in sched.step():
             decoded = c.tokens[:-1] if (not args.no_eos and c.reason == "eos"
                                         ) else c.tokens
-            logger.info(AUDIT_REQUEST_DONE_FMT.format(
-                id=c.request_id, reason=c.reason, prompt_tokens=c.prompt_len,
-                new_tokens=len(c.tokens), ttft_ms=c.ttft_seconds * 1e3,
-                tps=c.decode_tokens_per_sec))
+            events.emit_audit(
+                logger, AUDIT_REQUEST_DONE_FMT.format(
+                    id=c.request_id, reason=c.reason,
+                    prompt_tokens=c.prompt_len, new_tokens=len(c.tokens),
+                    ttft_ms=c.ttft_seconds * 1e3,
+                    tps=c.decode_tokens_per_sec),
+                "request_done", id=c.request_id, reason=c.reason,
+                tokens=len(c.tokens), ttft_ms=c.ttft_seconds * 1e3)
             logger.info("Request %s output: %r", c.request_id,
                         tokenizer.decode(decoded))
         if sched.iterations and sched.iterations % args.log_frequency == 0:
-            logger.info(AUDIT_SERVE_STEP_FMT.format(
-                step=sched.iterations, active=len(sched.active),
-                queued=len(sched.queue), done=len(sched.completed)))
+            events.emit_audit(
+                logger, AUDIT_SERVE_STEP_FMT.format(
+                    step=sched.iterations, active=len(sched.active),
+                    queued=len(sched.queue), done=len(sched.completed)),
+                "step", step=sched.iterations, active=len(sched.active),
+                queued=len(sched.queue), done=len(sched.completed))
 
     m = sched.metrics()
     logger.info("Serving metrics: %d requests | %d tokens | "
@@ -139,9 +170,15 @@ def main(argv=None) -> None:
                 m["tokens_per_sec"], m["tokens_per_sec_per_slot"],
                 m["decode_p50_ms"], m["decode_p95_ms"])
     if drained:
-        logger.info(AUDIT_SERVE_DRAINED_FMT.format(
-            completed=len(sched.completed), queued=len(sched.queue)))
-    logger.info(AUDIT_SERVE_COMPLETED)
+        events.emit_audit(
+            logger, AUDIT_SERVE_DRAINED_FMT.format(
+                completed=len(sched.completed), queued=len(sched.queue)),
+            "drain", phase="end", completed=len(sched.completed),
+            queued=len(sched.queue))
+    events.emit_audit(logger, AUDIT_SERVE_COMPLETED, "complete")
+    events.flush()
+    if metrics_server is not None:
+        metrics_server.stop()
     # exit 0 always — same contract as training: the exit POLICY is in the
     # logs, not the return code (nonzero would trip Slurm requeue logic)
     sys.exit(0)

@@ -1,0 +1,22 @@
+"""Goodput-accounted observability layer (SURVEY §5.5: the reference's only
+observability is grepping Slurm ``.out`` files for the ``[EXIT HANDLER]``
+audit trail).
+
+- :mod:`.events`    — structured JSONL flight recorder; every audit string
+  keeps its byte-identical text but also emits a typed event, and an
+  in-memory ring buffer is flushed on any exit path (crash forensics).
+- :mod:`.registry`  — counters / gauges / histograms behind the training and
+  serving metrics, rendered in Prometheus text format.
+- :mod:`.goodput`   — stitches event logs *across restarts* into goodput %,
+  MTTR, replayed tokens, and time lost per failure class (the headline
+  reliability metrics of MegaScale, arXiv:2402.15627, and Meta's cluster
+  reliability study, arXiv:2410.21680).
+- :mod:`.prometheus` — stdlib-only HTTP ``/metrics`` endpoint plus per-host
+  heartbeat gauges over the ft/multihost.py KV store.
+- :mod:`.trace`     — windowed ``jax.profiler`` capture (``--trace-steps
+  A:B``) with ``StepTraceAnnotation``.
+"""
+
+from . import events, registry
+
+__all__ = ["events", "registry"]

@@ -1,0 +1,272 @@
+"""Goodput accounting: stitch flight-recorder logs across restarts.
+
+The reference proves its fault-tolerance chain with three Slurm ``.out``
+files (timeout → resume → injected error → resume → scancel) that a human
+reads side by side. This module reads the same chain from the structured
+event logs (obs/events.py) and computes what production fault-tolerant
+trainers treat as the headline reliability metrics (MegaScale,
+arXiv:2402.15627; Meta's cluster reliability study, arXiv:2410.21680):
+
+- **goodput %** — wall time spent on *net-new* training steps divided by the
+  chain's total wall time. Step windows that re-train steps already reached
+  by an earlier job (replay after a lossy restart) count as lost, not good.
+- **MTTR** — per restart, the gap between the failing job's fault instant
+  (its ``signal``/``exit`` event, else its last event) and the next job's
+  first completed step window.
+- **replayed tokens** — per restart, (previous job's max step − restored
+  step) × tokens/step: the compute re-bought after each resume. Zero in
+  this framework's no-lost-steps design for save-bearing exits; non-zero
+  after a no-save exit (scancel) or a periodic-checkpoint gap.
+- **time lost per failure class** — restart downtime + replay wall,
+  attributed to the failing job's class (``timeout``/``error``/``cancel``).
+
+Input is one or more JSONL event files (typically
+``<ckpt-path>/events/events_<jobid>.jsonl``, one per Slurm job in the
+chain); jobs are ordered by first event time. ``scripts/goodput_report.py``
+is the CLI.
+"""
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .events import read_events
+
+FAILURE_CLASSES = {10: "timeout", 15: "cancel", -1: "error"}
+
+
+def failure_class(error_type: Optional[int]) -> str:
+    if error_type is None:
+        return "unknown"
+    return FAILURE_CLASSES.get(int(error_type), "unknown")
+
+
+@dataclasses.dataclass
+class Restart:
+    from_job: str
+    to_job: str
+    failure: str               # timeout | error | cancel | unknown
+    fault_t: float             # fault instant in the failing job
+    recovered_t: float         # first completed step window in the next job
+    restored_step: Optional[int]
+    prev_max_step: Optional[int]
+    replayed_steps: int
+    replayed_tokens: int
+    replay_seconds: float      # wall re-spent re-training replayed steps
+    restart_seconds: float     # recovered_t - fault_t (scheduler + setup)
+
+    @property
+    def mttr_seconds(self) -> float:
+        return self.restart_seconds
+
+    @property
+    def lost_seconds(self) -> float:
+        return self.restart_seconds + self.replay_seconds
+
+
+@dataclasses.dataclass
+class GoodputReport:
+    jobs: List[str]
+    wall_seconds: float
+    productive_seconds: float
+    replay_seconds: float
+    restarts: List[Restart]
+    steps_reached: Optional[int]
+    tokens_trained: int        # net-new tokens (replays not double-counted)
+    tokens_replayed: int
+
+    @property
+    def goodput_pct(self) -> float:
+        if self.wall_seconds <= 0:
+            return 0.0
+        return 100.0 * self.productive_seconds / self.wall_seconds
+
+    @property
+    def mttr_seconds(self) -> float:
+        if not self.restarts:
+            return 0.0
+        return sum(r.mttr_seconds for r in self.restarts) / len(self.restarts)
+
+    @property
+    def lost_by_class(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for r in self.restarts:
+            out[r.failure] = out.get(r.failure, 0.0) + r.lost_seconds
+        return out
+
+
+def _group_jobs(events: Sequence[dict]) -> List[List[dict]]:
+    """Split a flat event list into per-job runs ordered by first event."""
+    by_job: Dict[str, List[dict]] = {}
+    for ev in events:
+        by_job.setdefault(str(ev.get("job", "local")), []).append(ev)
+    jobs = []
+    for evs in by_job.values():
+        evs.sort(key=lambda e: e["t"])
+        jobs.append(evs)
+    jobs.sort(key=lambda evs: evs[0]["t"])
+    return jobs
+
+
+def _window_steps(ev: dict) -> int:
+    return int(ev.get("steps", 1))
+
+
+def _fault_event(evs: Sequence[dict]) -> Optional[dict]:
+    """The fault instant of one job: the first signal event if any, else the
+    exit verdict, else None (the job simply stopped — SIGKILL/node loss)."""
+    for ev in evs:
+        if ev["kind"] == "signal":
+            return ev
+    for ev in evs:
+        if ev["kind"] == "exit":
+            return ev
+    return None
+
+
+def stitch(events: Sequence[dict]) -> GoodputReport:
+    """Fold a (possibly multi-job) event list into a :class:`GoodputReport`.
+
+    Step accounting walks each job's ``step`` windows (payload ``steps`` =
+    steps covered, ``dur`` = window wall, ``step`` = last step in the
+    window). A window whose steps were already reached by an earlier job in
+    the chain is replay: its wall time moves from the productive to the
+    replay bucket and its tokens count as re-trained.
+    """
+    jobs = _group_jobs(events)
+    if not jobs:
+        return GoodputReport(jobs=[], wall_seconds=0.0,
+                             productive_seconds=0.0, replay_seconds=0.0,
+                             restarts=[], steps_reached=None,
+                             tokens_trained=0, tokens_replayed=0)
+
+    wall = jobs[-1][-1]["t"] - jobs[0][0]["t"]
+    productive = 0.0
+    replay_total = 0.0
+    tokens_new = 0
+    tokens_replayed_total = 0
+    restarts: List[Restart] = []
+    high_water: Optional[int] = None  # max step reached by earlier jobs
+    max_step: Optional[int] = None
+
+    for i, evs in enumerate(jobs):
+        job_id = str(evs[0].get("job", "local"))
+        job_max: Optional[int] = None
+        job_replay_seconds = 0.0
+        job_replayed_steps = 0
+        job_replayed_tokens = 0
+        first_step_t: Optional[float] = None
+        restored: Optional[int] = None
+        for ev in evs:
+            if ev["kind"] == "ckpt_restore" and restored is None:
+                restored = ev.get("step")
+            if ev["kind"] == "resume" and restored is None:
+                restored = ev.get("step")
+            if ev["kind"] != "step" or "step" not in ev:
+                continue
+            if first_step_t is None:
+                first_step_t = ev["t"]
+            last = int(ev["step"])
+            n = _window_steps(ev)
+            dur = float(ev.get("dur") or 0.0)
+            tokens = int(ev.get("tokens", 0))
+            job_max = last if job_max is None else max(job_max, last)
+            if high_water is not None and last <= high_water:
+                # whole window re-trains already-reached steps
+                job_replay_seconds += dur
+                job_replayed_steps += n
+                job_replayed_tokens += tokens
+            elif high_water is not None and last - n + 1 <= high_water:
+                # window straddles the high-water mark: pro-rate
+                replayed = high_water - (last - n)
+                frac = replayed / max(n, 1)
+                job_replay_seconds += dur * frac
+                job_replayed_steps += replayed
+                job_replayed_tokens += int(tokens * frac)
+                productive += dur * (1 - frac)
+                tokens_new += tokens - int(tokens * frac)
+            else:
+                productive += dur
+                tokens_new += tokens
+        replay_total += job_replay_seconds
+        tokens_replayed_total += job_replayed_tokens
+
+        if i > 0:
+            prev = jobs[i - 1]
+            fault = _fault_event(prev)
+            fault_t = fault["t"] if fault is not None else prev[-1]["t"]
+            error_type = None
+            if fault is not None:
+                error_type = fault.get("error_type", fault.get("signum"))
+            recovered_t = (first_step_t if first_step_t is not None
+                           else evs[-1]["t"])
+            restarts.append(Restart(
+                from_job=str(prev[0].get("job", "local")), to_job=job_id,
+                failure=failure_class(error_type), fault_t=fault_t,
+                recovered_t=recovered_t, restored_step=restored,
+                prev_max_step=high_water,
+                replayed_steps=job_replayed_steps,
+                replayed_tokens=job_replayed_tokens,
+                replay_seconds=job_replay_seconds,
+                restart_seconds=max(0.0, recovered_t - fault_t)))
+
+        if job_max is not None:
+            high_water = (job_max if high_water is None
+                          else max(high_water, job_max))
+            max_step = high_water
+
+    return GoodputReport(
+        jobs=[str(evs[0].get("job", "local")) for evs in jobs],
+        wall_seconds=wall, productive_seconds=productive,
+        replay_seconds=replay_total, restarts=restarts,
+        steps_reached=max_step, tokens_trained=tokens_new,
+        tokens_replayed=tokens_replayed_total)
+
+
+def load_chain(paths: Sequence[str]) -> List[dict]:
+    """Read events from files, directories, or globs, flattened."""
+    events: List[dict] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files = sorted(_glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            files = sorted(_glob.glob(p)) or [p]
+        for f in files:
+            events.extend(read_events(f))
+    return events
+
+
+def format_report(report: GoodputReport) -> str:
+    """Human-readable goodput report (the CLI's output)."""
+    lines = []
+    lines.append("Goodput report")
+    lines.append("=" * 64)
+    lines.append(f"jobs in chain     : {len(report.jobs)} "
+                 f"({', '.join(report.jobs) or '-'})")
+    lines.append(f"steps reached     : "
+                 f"{report.steps_reached if report.steps_reached is not None else '-'}")
+    lines.append(f"chain wall        : {report.wall_seconds:,.1f} s")
+    lines.append(f"productive        : {report.productive_seconds:,.1f} s")
+    lines.append(f"replayed          : {report.replay_seconds:,.1f} s "
+                 f"({report.tokens_replayed:,} tokens re-trained)")
+    lines.append(f"tokens trained    : {report.tokens_trained:,} (net new)")
+    lines.append(f"goodput           : {report.goodput_pct:.1f} %")
+    lines.append(f"restarts          : {len(report.restarts)} | "
+                 f"MTTR {report.mttr_seconds:,.1f} s")
+    if report.restarts:
+        lines.append("")
+        lines.append(f"{'from -> to':<22} {'class':<8} {'MTTR s':>8} "
+                     f"{'replay s':>9} {'replayed steps':>14} "
+                     f"{'restored@':>10}")
+        for r in report.restarts:
+            restored = r.restored_step if r.restored_step is not None else "-"
+            lines.append(
+                f"{r.from_job + ' -> ' + r.to_job:<22} {r.failure:<8} "
+                f"{r.mttr_seconds:>8.1f} {r.replay_seconds:>9.1f} "
+                f"{r.replayed_steps:>14} {str(restored):>10}")
+        lines.append("")
+        lines.append("time lost by failure class:")
+        for cls, secs in sorted(report.lost_by_class.items()):
+            lines.append(f"  {cls:<8} {secs:>10.1f} s")
+    return "\n".join(lines)

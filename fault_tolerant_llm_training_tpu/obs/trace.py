@@ -1,0 +1,142 @@
+"""Windowed ``jax.profiler`` capture (``--trace-steps A:B``).
+
+``--profile-dir`` alone traces the whole run — fine for a 5-step probe,
+useless for "step 400 regressed": a multi-hour trace is unloadably large.
+The window form arms the profiler at step A and disarms it after step B
+(inclusive), each captured step wrapped in a ``StepTraceAnnotation`` so
+XenseCope/TensorBoard group device ops per step. scripts/profile_step.py
+used to do this ad hoc with its own start/stop + parser; both now live
+here (:func:`capture`, :func:`parse_trace`) so the CLI window, the script,
+and the tests share one implementation.
+"""
+
+import collections
+import contextlib
+import glob
+import gzip
+import json
+import re
+from typing import Optional, Tuple
+
+
+def parse_window(spec: str) -> Tuple[int, int]:
+    """``"A:B"`` → (A, B) inclusive; ``"N"`` → (N, N). Raises ValueError on
+    malformed or empty windows — a silently-ignored trace flag is worse
+    than a failed launch."""
+    parts = spec.split(":")
+    try:
+        if len(parts) == 1:
+            a = b = int(parts[0])
+        elif len(parts) == 2:
+            a, b = int(parts[0]), int(parts[1])
+        else:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"--trace-steps expects 'A:B' or 'N', got {spec!r}") from None
+    if a < 0 or b < a:
+        raise ValueError(f"--trace-steps window {spec!r} is empty "
+                         f"(need 0 <= A <= B)")
+    return a, b
+
+
+class TraceWindow:
+    """Arms ``jax.profiler`` for steps in [start, stop] (inclusive).
+
+    The loop calls :meth:`on_step_start` before dispatching each step and
+    :meth:`on_step_end` after the step counter advances; :meth:`annotate`
+    wraps the dispatch in a ``StepTraceAnnotation``. ``drain`` (passed by
+    the trainer) runs before ``stop_trace`` so the asynchronously
+    dispatched device work of the window's final steps lands inside the
+    capture instead of after it.
+    """
+
+    def __init__(self, spec: str, trace_dir: str,
+                 drain: Optional[callable] = None):
+        self.start_step, self.stop_step = parse_window(spec)
+        self.trace_dir = trace_dir
+        self.drain = drain
+        self.active = False
+        self.done = False
+
+    def on_step_start(self, step: int) -> None:
+        if (not self.active and not self.done
+                and self.start_step <= step <= self.stop_step):
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+
+    def annotate(self, step: int):
+        if not self.active:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+    def on_step_end(self, step: int) -> None:
+        if self.active and step >= self.stop_step:
+            import jax
+
+            if self.drain is not None:
+                self.drain()
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+
+    def close(self) -> None:
+        """Stop a still-armed trace (loop exited inside the window)."""
+        if self.active:
+            import jax
+
+            try:
+                if self.drain is not None:
+                    self.drain()
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self.active = False
+            self.done = True
+
+
+@contextlib.contextmanager
+def capture(trace_dir: str):
+    """Whole-scope trace (scripts/profile_step.py's form)."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def parse_trace(trace_dir: str, steps: int):
+    """Aggregate device-side op durations from the newest Chrome-trace JSON
+    under ``trace_dir``. Returns (per-category ms/step dict, total
+    ms/step). This is how the kernel/copy/fusion breakdown in BASELINE.md
+    was measured."""
+    files = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz",
+                             recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    with gzip.open(files[-1]) as fh:
+        data = json.load(fh)
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in data["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    cat = collections.Counter()
+    for e in data["traceEvents"]:
+        if e.get("ph") != "X":
+            continue
+        pname = pids.get(e["pid"], "")
+        if "TPU" not in pname and "device" not in pname.lower():
+            continue
+        n = e["name"]
+        # skip the whole-program span and the per-execution lane aggregates
+        if n.startswith("jit_") or n.isdigit():
+            continue
+        cat[re.sub(r"\.\d+$", "", n)] += e.get("dur", 0)
+    total = sum(cat.values())
+    return ({k: v / steps / 1000 for k, v in cat.items()},
+            total / steps / 1000)

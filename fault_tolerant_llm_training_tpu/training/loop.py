@@ -18,9 +18,11 @@ resume bookkeeping, ref train.py:20-84) with the TPU-native differences:
 """
 
 import collections
+import contextlib
 import math
 import os
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -38,6 +40,9 @@ from ..ft import multihost
 from ..ft.multihost import PeerHostError, barrier
 from ..ft.signals import SignalFlag, TrainingSignal
 from ..models import Transformer, get_config
+from ..obs import events
+from ..obs.registry import REGISTRY
+from ..obs.trace import TraceWindow
 from ..parallel.mesh import make_mesh, use_mesh
 from ..parallel.sharding import batch_pspec, param_pspecs
 from ..training.state import TrainState
@@ -51,7 +56,14 @@ from ..utils.logging import (
     AUDIT_STEP_FMT,
     logger,
 )
-from ..utils.metrics import Throughput, hbm_usage_str
+from ..utils.metrics import (
+    Throughput,
+    device_peak_flops,
+    hbm_usage_str,
+    mfu,
+    per_device_memory_stats,
+    transformer_flops_per_token,
+)
 
 # Shared never-set token for watchdog callbacks run directly (single-process
 # and re-entrant paths) — they receive a cancellation event they can ignore.
@@ -122,6 +134,15 @@ class Trainer:
         # (ft/multihost.py) so all hosts raise at the same boundary; setup
         # checks are local-only and skipped on pods (see _setup_check).
         self._sync_signals = jax.process_count() > 1
+
+        # Flight recorder (obs/events.py): configured before any phase that
+        # can fault, so a signal during setup still leaves a JSONL trail
+        # the goodput stitcher can read. Same job-id naming contract as the
+        # checkpoints (checkpoint_{JOBID} <-> events_{JOBID}.jsonl).
+        self._job_id = JOBID or "local"
+        events.configure(cfg.event_log_path(self._job_id),
+                         job=self._job_id, host=jax.process_index())
+        self._init_metrics()
 
         self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp, pp=cfg.pp,
                               ep=cfg.ep)
@@ -286,14 +307,27 @@ class Trainer:
             abstract, self.state_shardings)
         abstract_sharded = self.abstract_state
         self._warn_if_state_exceeds_hbm(abstract_sharded)
+        # MFU denominator (bench.py convention): matmul params exclude the
+        # input-embedding gather; attention FLOPs causal-masked.
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(abstract.params))
+        self._flops_per_token = transformer_flops_per_token(
+            n_params - self.model_config.vocab_size * self.model_config.dim,
+            cfg.sequence_length, self.model_config.dim,
+            self.model_config.n_layers, causal=True)
 
         if read_mngr is not None:
+            t_restore = time.perf_counter()
             self.state, data_state, _ = read_mngr.restore(abstract_sharded)
             read_mngr.close()
             self.loader.set_state(data_state)
             self.training_step = int(self.state.step)
             self._last_data_state = data_state
             self._resumed = True
+            restore_secs = time.perf_counter() - t_restore
+            events.emit("ckpt_restore", step=self.training_step,
+                        dur=restore_secs, source_job=cfg.checkpoint_id)
+            self._m_restore.set(restore_secs)
             logger.info("Model loaded from checkpoint")  # ref: train.py:58
             logger.info("Optimizer loaded from checkpoint")  # ref: train.py:72
             logger.info("LR Scheduler loaded from checkpoint")  # ref: train.py:77
@@ -311,7 +345,7 @@ class Trainer:
         # Save manager for *this* job's id (ref naming: checkpoint_{JOBID},
         # utils.py:80) — files accumulate one dir per preemption, like the
         # reference accumulates one .ckpt per preemption.
-        self._save_job_id = JOBID or "local"
+        self._save_job_id = self._job_id
         self.ckpt_mngr = CheckpointManager(cfg.checkpoint_path,
                                            self._save_job_id)
         self._log_checkpoint_budget()
@@ -337,6 +371,43 @@ class Trainer:
                                            depth=cfg.prefetch)
         self.throughput = Throughput(
             tokens_per_step=cfg.batch_size * cfg.sequence_length)
+        if self._resumed:
+            # Reset on ckpt_restore: the warmup-exclusion window restarts
+            # here so the first post-resume tokens/s excludes the restore/
+            # recompile wall instead of mixing it into steady state, and
+            # the window is tagged so dashboards don't read the transient
+            # as a regression (utils/metrics.py Throughput docstring).
+            self.throughput.reset(tag="post_resume")
+
+        # Windowed profiler capture (--trace-steps A:B, obs/trace.py). The
+        # window drains the dispatch pipeline before stop_trace so the
+        # final steps' async device work lands inside the capture.
+        self._trace = None
+        if cfg.trace_steps:
+            trace_dir = cfg.profile_dir or os.path.join(
+                cfg.checkpoint_path or "/tmp",
+                f"traces_{self._job_id}")
+            self._trace = TraceWindow(
+                cfg.trace_steps, trace_dir,
+                drain=lambda: self._drain_inflight(check=False))
+            logger.info(f"Trace window | steps "
+                        f"{self._trace.start_step}:{self._trace.stop_step} "
+                        f"-> {trace_dir}")
+
+        # /metrics endpoint + per-host heartbeats (obs/prometheus.py).
+        self._metrics_server = None
+        self._heartbeat = None
+        if cfg.metrics_port:
+            from ..obs.prometheus import HeartbeatThread, MetricsServer
+
+            self._metrics_server = MetricsServer(port=cfg.metrics_port)
+            port = self._metrics_server.start()
+            logger.info(f"Metrics | serving /metrics on port {port}")
+            if cfg.heartbeat_seconds > 0:
+                self._heartbeat = HeartbeatThread(
+                    lambda: self.training_step,
+                    interval_seconds=cfg.heartbeat_seconds)
+                self._heartbeat.start()
 
         # --- held-out evaluation (no reference counterpart; SURVEY §5.5
         # notes training loss is the reference's only metric) ---
@@ -364,6 +435,53 @@ class Trainer:
                                grad_accum=cfg.grad_accum)).lower(
                 self.abstract_state.params, batch_struct,
                 batch_struct).compile()
+
+    def _init_metrics(self) -> None:
+        """Registry handles (obs/registry.py) — created once; the hot loop
+        only mutates leaf metrics. These replace the ad-hoc log-line-only
+        reporting: the same numbers now export at /metrics."""
+        r = REGISTRY
+        self._m_step_time = r.histogram(
+            "ftl_train_step_seconds",
+            "Per-step wall time, consume-to-consume (pipelined dispatch "
+            "makes this the steady-state step cadence)")
+        self._m_tps = r.gauge(
+            "ftl_train_tokens_per_sec",
+            "Steady-state tokens/s; window label tags post-resume "
+            "transients")
+        self._m_tokens = r.counter("ftl_train_tokens_total",
+                                   "Tokens trained by this process")
+        self._m_loss = r.gauge("ftl_train_loss", "Training loss")
+        self._m_gnorm = r.gauge("ftl_train_grad_norm",
+                                "Global gradient norm")
+        self._m_stepg = r.gauge("ftl_train_step",
+                                "Last consumed training step")
+        self._m_mfu = r.gauge(
+            "ftl_train_mfu",
+            "Model FLOPs utilization (0-1; TPU backends only — needs a "
+            "known peak)")
+        self._m_stall = r.counter(
+            "ftl_data_stall_seconds_total",
+            "Wall time the loop spent blocked on the input pipeline")
+        self._m_save = r.histogram(
+            "ftl_ckpt_save_seconds",
+            "Blocking checkpoint-save wall (fault-path and first periodic)")
+        self._m_saves = r.counter("ftl_ckpt_saves_total",
+                                  "Checkpoints written")
+        self._m_restore = r.gauge("ftl_ckpt_restore_seconds",
+                                  "Checkpoint restore wall at setup")
+        self._m_eval_loss = r.gauge("ftl_eval_loss",
+                                    "Held-out eval loss (token-weighted)")
+        self._m_hbm_used = r.gauge(
+            "ftl_device_hbm_bytes_in_use",
+            "Per-device HBM in use (utils/metrics.py "
+            "per_device_memory_stats)")
+        self._m_hbm_limit = r.gauge("ftl_device_hbm_bytes_limit",
+                                    "Per-device HBM limit")
+        self._last_consume_t = None
+        # (wall clock, last step) already covered by a step event; the next
+        # event's dur/steps are deltas against this.
+        self._step_window_start = None
 
     def _warn_if_state_exceeds_hbm(self, abstract_sharded) -> None:
         """Pre-flight capacity estimate: warn (don't fail — remat and fusion
@@ -442,13 +560,22 @@ class Trainer:
     # ------------------------------------------------------------------ run
     def run(self) -> None:
         cfg = self.cfg
+        tokens_per_step = cfg.batch_size * cfg.sequence_length
+        self._step_window_start = (time.time(), self.training_step - 1)
         if self._resumed:
             # ref: train.py:81
-            logger.info(AUDIT_RESUME_FMT.format(step=self.training_step))
+            events.emit_audit(
+                logger, AUDIT_RESUME_FMT.format(step=self.training_step),
+                "resume", step=self.training_step,
+                tokens_per_step=tokens_per_step)
         else:
-            logger.info(AUDIT_START)  # ref: train.py:84
+            # ref: train.py:84
+            events.emit_audit(logger, AUDIT_START, "start", step=0,
+                              tokens_per_step=tokens_per_step)
 
-        if cfg.profile_dir:
+        if cfg.profile_dir and not cfg.trace_steps:
+            # bare --profile-dir keeps its whole-run capture; --trace-steps
+            # supersedes it with the bounded window (obs/trace.py)
             jax.profiler.start_trace(cfg.profile_dir)
         try:
             self._loop()
@@ -463,8 +590,10 @@ class Trainer:
                 multihost.announce_local_error(self._dispatched)
             raise
         finally:
-            if cfg.profile_dir:
+            if cfg.profile_dir and not cfg.trace_steps:
                 jax.profiler.stop_trace()
+            if self._trace is not None:
+                self._trace.close()
 
     def _loop(self) -> None:
         cfg = self.cfg
@@ -508,9 +637,18 @@ class Trainer:
             else:
                 self.signal_flag.check()
             first_iteration = False
+            t_fetch = time.perf_counter()
             inputs, labels, data_state = next(it)
-            self.state, metrics = self._compiled_step(self.state, inputs,
-                                                      labels)
+            # Data-stall accounting: with the prefetcher healthy this is
+            # ~0; a growing counter at /metrics means the input pipeline,
+            # not the TPU, is the bottleneck.
+            self._m_stall.inc(time.perf_counter() - t_fetch)
+            if self._trace is not None:
+                self._trace.on_step_start(self.training_step)
+            with (self._trace.annotate(self.training_step)
+                  if self._trace is not None else contextlib.nullcontext()):
+                self.state, metrics = self._compiled_step(self.state,
+                                                          inputs, labels)
             self._dispatched += 1
             self._last_data_state = data_state
             # The jitted step pre-packs (loss, grad_norm) into one array so
@@ -533,6 +671,8 @@ class Trainer:
                 if cfg.error_local_rank == jax.process_index():
                     raise Exception(
                         "Simulated exception to test signal handler", -1)
+            if self._trace is not None:
+                self._trace.on_step_end(self.training_step)
             self.training_step += 1
             if (cfg.checkpoint_frequency
                     and self.training_step % cfg.checkpoint_frequency == 0):
@@ -549,9 +689,28 @@ class Trainer:
                     and self.training_step % cfg.eval_frequency == 0):
                 self._evaluate()
         self._drain_inflight()
+        self._emit_tail_window()
         if (self._compiled_eval is not None
                 and self.training_step % cfg.eval_frequency != 0):
             self._evaluate()  # final eval unless the last step just ran one
+
+    def _emit_tail_window(self) -> None:
+        """Close the step-window accounting. Steps drained with
+        ``check=False`` (pre-save drains) skip metric consumption by design,
+        so a run whose last act was a periodic save would leave its final
+        window unrecorded — the goodput stitcher would count those steps'
+        wall as lost. One synthetic window event covers the gap."""
+        if self._step_window_start is None:
+            return
+        prev_t, prev_step = self._step_window_start
+        last = self.training_step - 1
+        if last <= prev_step:
+            return
+        now_wall = time.time()
+        n = last - prev_step
+        events.emit(kind="step", step=last, dur=now_wall - prev_t, steps=n,
+                    tokens=n * self.throughput.tokens_per_step, tail=True)
+        self._step_window_start = (now_wall, last)
 
     def _evaluate(self) -> None:
         """One held-out pass: ``--eval-batches`` batches, token-weighted mean
@@ -569,11 +728,16 @@ class Trainer:
             labels = jax.device_put(labels, self.batch_sharding)
             packed.append(self._compiled_eval(self.state.params, inputs,
                                               labels))
+        t0 = time.perf_counter()
         totals = np.sum([np.asarray(p) for p in packed], axis=0)
         loss = float(totals[0]) / max(float(totals[1]), 1.0)
         ppl = math.exp(min(loss, 700.0))
+        self._m_eval_loss.set(loss)
         logger.info(f"Eval | step {self.training_step} | loss {loss:.4f} | "
                     f"ppl {ppl:.2f} | tokens {int(totals[1])}")
+        events.emit(kind="eval", step=self.training_step,
+                    dur=time.perf_counter() - t0, loss=loss, ppl=ppl,
+                    tokens=int(totals[1]))
 
     def _drain_inflight(self, check: bool = True, cancelled=None) -> None:
         """Consume every dispatched-but-unfinished step.
@@ -652,18 +816,55 @@ class Trainer:
             raise NonFiniteGradientError(
                 f"non-finite gradient norm {grad_norm} at step {step_no}")
         self.throughput.step()
+        now = time.perf_counter()
+        if self._last_consume_t is not None:
+            self._m_step_time.observe(now - self._last_consume_t)
+        self._last_consume_t = now
         self.last_loss = loss
+        self._m_loss.set(loss)
+        self._m_gnorm.set(grad_norm)
+        self._m_stepg.set(step_no)
+        self._m_tokens.inc(self.throughput.tokens_per_step)
         if step_no == 1 or step_no % self.cfg.logging_frequency == 0:
-            # ref: train.py:115-116 (exact format), plus throughput extras
-            logger.info(AUDIT_STEP_FMT.format(step=step_no,
-                                              loss=self.last_loss))
+            # ref: train.py:115-116 (exact format), plus throughput extras.
+            # The audit string stays byte-identical; the paired event
+            # carries the window accounting goodput stitching needs.
+            prev_t, prev_step = (self._step_window_start
+                                 or (time.time(), step_no - 1))
+            steps_in_window = max(1, step_no - prev_step)
+            now_wall = time.time()
+            events.emit_audit(
+                logger, AUDIT_STEP_FMT.format(step=step_no,
+                                              loss=self.last_loss),
+                "step", step=step_no, dur=now_wall - prev_t,
+                steps=steps_in_window,
+                tokens=steps_in_window * self.throughput.tokens_per_step,
+                loss=loss, grad_norm=grad_norm)
+            self._step_window_start = (now_wall, step_no)
             tps = self.throughput.tokens_per_sec
             if tps:
+                window = self.throughput.window_tag or "steady"
+                self._m_tps.labels(window=window).set(tps)
+                peak = device_peak_flops()
+                if peak:
+                    self._m_mfu.set(mfu(tps / max(jax.process_count(), 1)
+                                        / max(jax.local_device_count(), 1),
+                                        self._flops_per_token, peak))
+                for dev, used, limit in per_device_memory_stats():
+                    self._m_hbm_used.labels(device=dev).set(used)
+                    if limit:
+                        self._m_hbm_limit.labels(device=dev).set(limit)
                 hbm = hbm_usage_str()
                 logger.info(
                     f"Metrics | step {step_no} | grad_norm "
                     f"{grad_norm:.3f} | tokens/s {tps:,.0f}"
-                    + (f" | hbm {hbm}" if hbm else ""))
+                    + (f" | hbm {hbm}" if hbm else "")
+                    + (" | window post_resume"
+                       if self.throughput.window_tag else ""))
+                if self.throughput.window_tag:
+                    # the transient window has now been reported once,
+                    # tagged; subsequent windows are steady-state again
+                    self.throughput.clear_tag()
 
     # ---------------------------------------------------------- fault fence
     def coordinate_local_error(self) -> bool:
@@ -785,6 +986,13 @@ class Trainer:
                     logger, "collective checkpoint write stalled")
         else:
             self.ckpt_mngr.save(step, self.state, data_state, wait=wait)
+        self._m_saves.inc()
+        if wait and self.ckpt_mngr.last_save_seconds is not None:
+            self._m_save.observe(self.ckpt_mngr.last_save_seconds)
+        events.emit(kind="ckpt_save", step=step,
+                    dur=(self.ckpt_mngr.last_save_seconds
+                         if wait else None),
+                    blocking=bool(wait), fault=bool(fault))
         if wait and self.ckpt_mngr.last_save_seconds is not None:
             # observed wall for blocking (fault-path) saves: the number the
             # startup budget estimate exists to predict
@@ -813,6 +1021,15 @@ class Trainer:
     def close(self) -> None:
         self.prefetcher.stop()
         self.ckpt_mngr.close()
+        if self._trace is not None:
+            self._trace.close()
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        events.flush()
         if self._mesh_ctx is not None:
             self._mesh_ctx.__exit__(None, None, None)
             self._mesh_ctx = None

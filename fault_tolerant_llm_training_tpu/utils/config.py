@@ -112,8 +112,33 @@ class TrainConfig:
     # save time against this budget at startup (checkpoint/manager.py).
     signal_lead_seconds: int = 120
     profile_dir: str = ""  # jax.profiler trace output; "" = off
+    # Windowed profiler capture "A:B" (steps A..B inclusive; obs/trace.py).
+    # Traces land in --profile-dir (or <checkpoint-path>/traces when unset).
+    # Unlike bare --profile-dir, the capture is bounded — usable mid-run on
+    # long jobs.
+    trace_steps: str = ""
+    # Structured JSONL flight-recorder output dir (obs/events.py); "" =
+    # <checkpoint-path>/events, "off" = disabled. One events_<jobid>.jsonl
+    # per job; scripts/goodput_report.py stitches them across restarts.
+    event_log_dir: str = ""
+    # Serve the metric registry at http://host:PORT/metrics (Prometheus
+    # text format, obs/prometheus.py); 0 = off.
+    metrics_port: int = 0
+    # Per-host heartbeat publish interval through the ft/multihost.py KV
+    # store (exported as ftl_host_heartbeat_* gauges); 0 = off. Only
+    # active when --metrics-port is set (the gauges need a scraper).
+    heartbeat_seconds: float = 10.0
     resubmit_command: str = ""  # override for tests; default: sbatch $WORKDIR/train.sh
     distributed: bool = False  # call jax.distributed.initialize() (multi-host pods)
+
+    def event_log_path(self, job_id: str) -> str:
+        """Resolved flight-recorder path for this job; '' = disabled."""
+        if self.event_log_dir == "off":
+            return ""
+        base = self.event_log_dir or (
+            os.path.join(self.checkpoint_path, "events")
+            if self.checkpoint_path else "")
+        return os.path.join(base, f"events_{job_id}.jsonl") if base else ""
 
 
 def get_args(argv: Optional[list] = None) -> TrainConfig:
@@ -304,6 +329,23 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                              "budget check warns when the estimated save "
                              "exceeds it")
     parser.add_argument("--profile-dir", type=str, default="")
+    parser.add_argument("--trace-steps", type=str, default="",
+                        help="Windowed jax.profiler capture 'A:B' (steps A "
+                             "through B inclusive, obs/trace.py); bounded, "
+                             "so usable mid-run on long jobs. Output: "
+                             "--profile-dir or <checkpoint-path>/traces")
+    parser.add_argument("--event-log-dir", type=str, default="",
+                        help="Flight-recorder JSONL dir (obs/events.py): "
+                             "one events_<jobid>.jsonl per job, stitched "
+                             "across restarts by scripts/goodput_report.py."
+                             " '' = <checkpoint-path>/events, 'off' = "
+                             "disabled")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="Serve Prometheus /metrics on this port "
+                             "(obs/prometheus.py); 0 = off")
+    parser.add_argument("--heartbeat-seconds", type=float, default=10.0,
+                        help="Per-host heartbeat publish interval (KV "
+                             "store; ftl_host_heartbeat_* gauges); 0 = off")
     parser.add_argument("--resubmit-command", type=str, default="",
                         help="Override the self-resubmit command (tests); "
                              "default: sbatch $WORKDIR/train.sh $SLURM_JOB_ID")

@@ -2,17 +2,38 @@
 the only reference metric is the loss line at train.py:115-116)."""
 
 import time
+from typing import List, Optional, Tuple
 
 
 class Throughput:
-    """Steady-state tokens/sec and step-time tracker (excludes warmup steps)."""
+    """Steady-state tokens/sec and step-time tracker (excludes warmup steps).
+
+    ``reset(tag=...)`` restarts the warmup-exclusion window and tags the
+    next measured window; the trainer calls it on ``ckpt_restore`` so the
+    first post-resume tokens/s figure (a) excludes the restore/recompile
+    wall from its denominator instead of mixing it into "steady state", and
+    (b) carries a ``window='post_resume'`` label in the emitted metric so
+    dashboards don't read the transient as a regression.
+    """
 
     def __init__(self, tokens_per_step: int, warmup_steps: int = 2):
         self.tokens_per_step = tokens_per_step
         self.warmup_steps = warmup_steps
+        self.window_tag: Optional[str] = None
         self._seen = 0
         self._t0 = None
         self._steps = 0
+
+    def reset(self, tag: Optional[str] = None) -> None:
+        """Restart the meter (fresh warmup window); ``tag`` labels the new
+        window until :meth:`clear_tag`."""
+        self._seen = 0
+        self._t0 = None
+        self._steps = 0
+        self.window_tag = tag
+
+    def clear_tag(self) -> None:
+        self.window_tag = None
 
     def step(self) -> None:
         self._seen += 1
@@ -49,20 +70,64 @@ def mfu(tokens_per_sec: float, flops_per_token: float, peak_flops: float) -> flo
     return tokens_per_sec * flops_per_token / peak_flops
 
 
-def device_memory_stats():
-    """(bytes_in_use, bytes_limit) for device 0; (None, None) where the
-    backend exposes no memory_stats (CPU; some remote transports)."""
+V5E_BF16_PEAK = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
+
+
+def device_peak_flops() -> Optional[float]:
+    """Per-chip peak FLOP/s for MFU, or None off-TPU. Same convention as
+    bench.py: the constant is v5e-specific, so MFU is only claimed on an
+    actual TPU backend — a CPU 'MFU' against a TPU peak is noise."""
     try:
         import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
+        backend = jax.default_backend()
     except Exception:
+        return None
+    return V5E_BF16_PEAK if backend == "tpu" else None
+
+
+def per_device_memory_stats() -> List[Tuple[str, Optional[int], Optional[int]]]:
+    """``(device id string, bytes_in_use, bytes_limit)`` for every LOCAL
+    device; empty where the backend exposes no memory_stats (CPU; some
+    remote transports). Feeds the per-device HBM gauges in the metric
+    registry — under pipeline/tensor sharding the devices are NOT
+    symmetric (stage 0 holds the embedding, the last stage the LM head),
+    and the loudest device is the one that OOMs."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            continue
+        used = stats.get("bytes_in_use")
+        limit = (stats.get("bytes_limit")
+                 or stats.get("bytes_reservable_limit"))
+        if used is None:
+            continue
+        out.append((str(getattr(d, "id", len(out))), used, limit))
+    return out
+
+
+def device_memory_stats():
+    """(bytes_in_use, bytes_limit) of the most-loaded local device —
+    max-over-devices, the binding constraint under pipeline/tensor sharding
+    where per-device footprints differ (device 0 alone underestimates the
+    OOM risk by up to a stage's worth of params). (None, None) where the
+    backend exposes no memory_stats."""
+    stats = per_device_memory_stats()
+    if not stats:
         return None, None
-    return (stats.get("bytes_in_use"),
-            stats.get("bytes_limit") or stats.get("bytes_reservable_limit"))
+    _, used, limit = max(stats, key=lambda s: s[1])
+    return used, limit
 
 
 def hbm_usage_str() -> str:
-    """'x.x/y.y GB' for device 0, or '' without backend memory stats."""
+    """'x.x/y.y GB' for the most-loaded device, or '' without backend
+    memory stats."""
     used, limit = device_memory_stats()
     if used is None:
         return ""

@@ -15,43 +15,18 @@ illustrative only.
 """
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def parse_trace(trace_dir: str, steps: int):
-    """Aggregate device-side op durations from the newest trace in
-    ``trace_dir``. Returns (per-category ms/step dict, total ms/step)."""
-    files = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True))
-    if not files:
-        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
-    with gzip.open(files[-1]) as fh:
-        data = json.load(fh)
-    pids = {e["pid"]: e["args"].get("name", "")
-            for e in data["traceEvents"]
-            if e.get("ph") == "M" and e.get("name") == "process_name"}
-    cat = collections.Counter()
-    for e in data["traceEvents"]:
-        if e.get("ph") != "X":
-            continue
-        pname = pids.get(e["pid"], "")
-        if "TPU" not in pname and "device" not in pname.lower():
-            continue
-        n = e["name"]
-        # skip the whole-program span and the per-execution lane aggregates
-        if n.startswith("jit_") or n.isdigit():
-            continue
-        cat[re.sub(r"\.\d+$", "", n)] += e.get("dur", 0)
-    total = sum(cat.values())
-    return ({k: v / steps / 1000 for k, v in cat.items()},
-            total / steps / 1000)
+# The trace parser and the capture context live in obs/trace.py now (shared
+# with train.py --trace-steps); re-exported here because this module-level
+# name is the tool's API (tests/test_profile_tool.py imports it).
+from fault_tolerant_llm_training_tpu.obs.trace import (  # noqa: E402
+    capture,
+    parse_trace,
+)
 
 
 def main():
@@ -85,11 +60,10 @@ def main():
     state, m = step(state, toks, labels)  # compile outside the trace
     hard_sync(m)
 
-    jax.profiler.start_trace(args.trace_dir)
-    for _ in range(args.steps):
-        state, m = step(state, toks, labels)
-    hard_sync(m)
-    jax.profiler.stop_trace()
+    with capture(args.trace_dir):
+        for _ in range(args.steps):
+            state, m = step(state, toks, labels)
+        hard_sync(m)
 
     cats, total = parse_trace(args.trace_dir, args.steps)
     print(f"\ndevice time by op family ({args.model}, "

@@ -1,0 +1,136 @@
+"""Frozen audit-string contract.
+
+The audit strings in utils/logging.py are the system's verification API —
+the reference README greps Slurm ``.out`` files for them, and the
+fault-tolerance tests assert on them. This module freezes each string
+against a pinned literal (NOT imported constants compared to themselves:
+the pin must break when anyone edits the string), and enforces the
+flight-recorder invariant: audit strings are only ever emitted through
+``obs.events.emit_audit``, which pairs every byte-identical log line with
+exactly one structured event.
+"""
+
+import logging
+import re
+from pathlib import Path
+
+from fault_tolerant_llm_training_tpu.obs import events as events_mod
+from fault_tolerant_llm_training_tpu.utils import logging as ftl_logging
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "fault_tolerant_llm_training_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    events_mod._RECORDER = events_mod.FlightRecorder()
+    yield
+    events_mod._RECORDER = events_mod.FlightRecorder()
+
+# Pinned byte-for-byte. ref: utils.py:68,71,73,81,86,88,90; train.py:81,84,
+# 116,118 — plus the serving trail introduced with inference/serve.py.
+FROZEN = {
+    "AUDIT_CANCELLED": "[EXIT HANDLER] Job cancelled, terminating.",
+    "AUDIT_TIMEOUT_SAVING": "[EXIT HANDLER] Job timed out, saving checkpoint.",
+    "AUDIT_ERROR_SAVING":
+        "[EXIT HANDLER] Error during training encountered, saving checkpoint.",
+    "AUDIT_SAVED_FMT": "[EXIT HANDLER] Checkpoint saved at step {step}",
+    "AUDIT_REQUEUE_FAILED_FMT":
+        "[EXIT HANDLER] Failed to requeue job {job_id}.",
+    "AUDIT_REQUEUED":
+        "[EXIT HANDLER] sbatch requeued, new job will load the last checkpoint",
+    "AUDIT_UNKNOWN_FMT":
+        "[EXIT HANDLER] Unknown exit signal {type}, terminating.",
+    "AUDIT_RESUME_FMT": "Resuming training from training_step {step}",
+    "AUDIT_START": "Starting training!",
+    "AUDIT_COMPLETED": "Training completed",
+    "AUDIT_STEP_FMT": "Training step: {step} | Loss: {loss:.2f}",
+    "AUDIT_SERVE_START": "Starting serving!",
+    "AUDIT_SERVE_READY_FMT":
+        "Serving ready | model {model} | checkpoint step {step} | "
+        "slots {slots}",
+    "AUDIT_SERVE_STEP_FMT":
+        "Serve step: {step} | Active: {active} | Queued: {queued} | "
+        "Done: {done}",
+    "AUDIT_SERVE_DRAINING_FMT":
+        "[EXIT HANDLER] Signal {signum} received, draining {active} "
+        "in-flight request(s), admission stopped.",
+    "AUDIT_SERVE_DRAINED_FMT":
+        "[EXIT HANDLER] Drained; {completed} request(s) completed, "
+        "{queued} queued request(s) not admitted.",
+    "AUDIT_REQUEST_DONE_FMT":
+        "Request {id} done | {reason} | prompt {prompt_tokens} tok | "
+        "generated {new_tokens} tok | ttft {ttft_ms:.0f} ms | "
+        "{tps:.1f} tok/s",
+    "AUDIT_SERVE_COMPLETED": "Serving completed",
+}
+
+
+def test_audit_strings_are_byte_identical_to_pins():
+    for name, pinned in FROZEN.items():
+        actual = getattr(ftl_logging, name)
+        assert actual == pinned, (
+            f"{name} drifted from the frozen contract:\n"
+            f"  pinned : {pinned!r}\n  actual : {actual!r}\n"
+            f"These strings are the grep-the-.out-file verification API — "
+            f"changing one silently breaks the reference's checks.")
+
+
+def test_no_new_unpinned_audit_strings():
+    declared = {n for n in dir(ftl_logging) if n.startswith("AUDIT_")}
+    assert declared == set(FROZEN), (
+        "utils/logging.py and the frozen pin table disagree; add the new "
+        "string (and its pin) here so it is contract-checked too")
+
+
+def test_audit_strings_emitted_only_through_emit_audit():
+    """``logger.info(AUDIT_*`` must not exist outside obs/events.py: the raw
+    form logs the text without the paired structured event, so the flight
+    recorder would silently miss that emission."""
+    pattern = re.compile(r"\.\s*info\(\s*AUDIT_")
+    offenders = []
+    for path in [REPO / "train.py", *PKG.rglob("*.py")]:
+        if path == PKG / "obs" / "events.py":
+            continue  # the docstring naming the banned form
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if pattern.search(line):
+                offenders.append(f"{path.relative_to(REPO)}:{i}: {line.strip()}")
+    assert not offenders, (
+        "raw logger.info(AUDIT_*) call sites found — route these through "
+        "obs.events.emit_audit:\n" + "\n".join(offenders))
+
+
+def test_emit_audit_pairs_one_event_per_emission(tmp_path):
+    """Every emit_audit call: the audit text logged exactly once,
+    byte-identical, plus exactly one structured event with matching step."""
+    path = str(tmp_path / "ev.jsonl")
+    events_mod.configure(path, job="contract")
+    log = logging.getLogger("ftl-test-contract")
+    lines = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            lines.append(record.getMessage())
+
+    log.addHandler(_Capture())
+    log.setLevel(logging.INFO)
+
+    emissions = [
+        (ftl_logging.AUDIT_STEP_FMT.format(step=7, loss=2.5), "step", 7),
+        (ftl_logging.AUDIT_SAVED_FMT.format(step=7), "exit", 7),
+        (ftl_logging.AUDIT_TIMEOUT_SAVING, "signal", None),
+        (ftl_logging.AUDIT_RESUME_FMT.format(step=7), "resume", 7),
+    ]
+    for text, kind, step in emissions:
+        events_mod.emit_audit(log, text, kind, step=step)
+    events_mod.flush()
+    evs = events_mod.read_events(path)
+    assert len(evs) == len(emissions) == len(lines)
+    for (text, kind, step), ev, line in zip(emissions, evs, lines):
+        assert line == text
+        assert ev["kind"] == kind
+        assert ev.get("step") == step
+        assert ev["audit"] is True
+    events_mod.configure(None)

@@ -12,6 +12,7 @@ from fault_tolerant_llm_training_tpu.ft.handler import (
     handle_exit,
 )
 from fault_tolerant_llm_training_tpu.ft.signals import SignalFlag
+from fault_tolerant_llm_training_tpu.obs import events
 from fault_tolerant_llm_training_tpu.training.loop import Trainer
 from fault_tolerant_llm_training_tpu.utils.config import get_args
 from fault_tolerant_llm_training_tpu.utils.logging import (
@@ -36,7 +37,11 @@ def train(cfg) -> None:
         with flag.deferred():
             trainer = Trainer(cfg, signal_flag=flag)
         trainer.run()
-        logger.info(AUDIT_COMPLETED)  # ref: train.py:118
+        # ref: train.py:118 — audit string byte-identical; the paired event
+        # closes the flight-recorder chain for goodput stitching.
+        events.emit_audit(logger, AUDIT_COMPLETED, "complete",
+                          step=trainer.training_step)
+        events.flush()
         sys.exit(0)
     except Exception as e:
         error_type = classify_exception(e)  # ref: train.py:122-126
