@@ -1,13 +1,19 @@
 """TPU-native inference & serving subsystem.
 
 Loads any training checkpoint (checkpoint/manager.py cross-topology restore)
-and serves it through the trained modules themselves: a static-shape GQA
-KV slot cache (kv_cache.py) threaded through ``models/llama.py``'s cached
-forward, jitted prefill/decode steps with an AOT-compiled prefill bucket set
-(engine.py), per-slot seeded sampling (sampler.py), slot-based continuous
-batching (scheduler.py), and a signal-drained lifecycle driver (serve.py)
-that reuses the training stack's ``ft/signals.py`` flags and audit-string
-logging discipline.
+and serves it through the trained modules themselves: a static-shape GQA KV
+cache — block-paged pool + per-slot block tables by default, legacy
+per-slot ring buffers behind ``kv_layout="ring"`` (kv_cache.py) — threaded
+through ``models/llama.py``'s cached forward, jitted prefill/decode steps
+with an AOT-compiled prefill bucket set and chunked prefill for prompts
+longer than the largest bucket (engine.py), per-slot seeded sampling
+(sampler.py), slot-based continuous batching with block-count admission
+(scheduler.py), and a signal-drained lifecycle driver (serve.py) that
+reuses the training stack's ``ft/signals.py`` flags and audit-string
+logging discipline — including chunk-boundary drain for mid-prompt
+signals. The paged attention path gathers blocks into the contiguous
+layout and runs the exact ring kernel, so the two layouts bit-match
+(tests/test_paged_kv.py).
 
 Deliberately import-light: ``models/llama.py`` imports ``kv_cache`` for the
 cache write primitive, so this package must not eagerly import the engine

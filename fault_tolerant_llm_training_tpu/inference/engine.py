@@ -1,18 +1,26 @@
 """Jitted prefill/decode engine over the trained modules.
 
 The engine owns the device state of a serving process: the (possibly
-tensor-parallel) params, the :class:`~.kv_cache.KVCache`, and two compiled
-programs —
+tensor-parallel) params, the KV cache (paged block pools by default,
+``kv_layout="ring"`` for the legacy per-slot ring buffers kept for
+equivalence testing), and two families of compiled programs —
 
 - **prefill**: one request's prompt through ``Transformer.forward_with_cache``
-  into a single cache slot (B=1, S=bucket, offset 0), sampling the first
-  generated token from the last prompt position. Prompts are right-padded to
-  a static **bucket** length; the whole bucket set is AOT-compiled at engine
-  build (``jit(...).lower(...).compile()``), so serving never hits a compile
-  stall mid-traffic — the same discipline as the trainer's AOT train step.
+  into a single cache slot (B=1, S=bucket), sampling the first generated
+  token from the last prompt position. Prompts are right-padded to a static
+  **bucket** length; the whole bucket set is AOT-compiled at engine build
+  (``jit(...).lower(...).compile()``), so serving never hits a compile stall
+  mid-traffic — the same discipline as the trainer's AOT train step. Under
+  the paged layout prefill is **chunked**: a prompt longer than the largest
+  bucket streams through it in fixed-size chunks at increasing offsets
+  (Sarathi-Serve's chunked prefill), so the bucket set caps COMPILE COUNT,
+  not prompt length — any prompt up to ``max_len`` is served, and the host
+  loop can be interrupted cleanly between chunks for the drain lifecycle.
 - **decode**: one token for ALL slots at once (B=slots, S=1, per-slot
   offsets = cache lengths). The cache is donated (``donate_argnums``), so
-  XLA aliases the ring buffers in place.
+  XLA aliases the pools/ring buffers in place; the paged layout additionally
+  takes the scheduler's (slots, blocks_per_slot) block tables as a plain
+  host argument each call.
 
 Checkpoints restore through the existing cross-topology
 ``checkpoint/manager.py`` path (:meth:`InferenceEngine.from_checkpoint`):
@@ -28,7 +36,8 @@ table values at absolute positions, and an attention kernel mirroring
 """
 
 import logging
-from typing import Optional, Sequence
+import os
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -38,10 +47,50 @@ from ..models.configs import TransformerConfig
 from ..models.llama import Transformer, unstack_layer_params
 from ..parallel.mesh import use_mesh
 from ..parallel.sharding import param_shardings
-from .kv_cache import KVCache, cache_shardings, init_cache
+from .kv_cache import (
+    KVCache,
+    PagedKVCache,
+    blocks_per_slot,
+    cache_shardings,
+    init_cache,
+    init_paged_cache,
+)
 from .sampler import sample_token, slot_key
 
 logger = logging.getLogger()
+
+DEFAULT_COMPILE_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "fault_tolerant_llm_training_tpu",
+    "xla-cache")
+
+
+def enable_compilation_cache(cache_dir: str = DEFAULT_COMPILE_CACHE_DIR
+                             ) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Engine builds AOT-compile a decode program plus one prefill program per
+    bucket; cold that dominates small-run wall time (16.8 s of the tiny CPU
+    bench), warm it is a disk read. No-ops (returns False) when ``cache_dir``
+    is empty, when the user already configured a cache (the
+    ``JAX_COMPILATION_CACHE_DIR`` env var / prior config.update wins), or on
+    jax versions without the option. Min-compile-time/entry-size floors drop
+    to 0 so even the tiny test programs cache.
+    """
+    if not cache_dir:
+        return False
+    try:
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            return True  # already configured (env var or earlier call)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # pragma: no cover - ancient jax
+        return False
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # pragma: no cover - knob absent on this jax
+            pass
+    return True
 
 
 def default_prefill_buckets(max_len: int, smallest: int = 16
@@ -76,7 +125,11 @@ class InferenceEngine:
     def __init__(self, cfg: TransformerConfig, params, *, slots: int = 2,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 top_k: int = 0, cache_dtype=None, mesh=None):
+                 top_k: int = 0, cache_dtype=None, mesh=None,
+                 kv_layout: str = "paged", kv_block_size: int = 16,
+                 kv_num_blocks: Optional[int] = None):
+        if kv_layout not in ("paged", "ring"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
         if cfg.layer_impl == "scan":
             params = unstack_layer_params(params, cfg.n_layers)
             cfg = cfg.replace(layer_impl="loop")
@@ -86,6 +139,7 @@ class InferenceEngine:
         self.slots = slots
         self.max_len = max_len or cfg.seq_len
         self.top_k = top_k
+        self.kv_layout = kv_layout
         self.restored_step: Optional[int] = None
         buckets = tuple(sorted(set(prefill_buckets
                                    or default_prefill_buckets(self.max_len))))
@@ -93,6 +147,12 @@ class InferenceEngine:
             raise ValueError(f"prefill bucket {buckets[-1]} exceeds "
                              f"max_len {self.max_len}")
         self.prefill_buckets = buckets
+        if kv_layout == "paged":
+            self.block_size = kv_block_size
+            self.max_blocks_per_slot = blocks_per_slot(self.max_len,
+                                                       kv_block_size)
+            self.num_blocks = (kv_num_blocks
+                               or slots * self.max_blocks_per_slot + 1)
         self.model = Transformer(cfg)
 
         with use_mesh(mesh):
@@ -100,11 +160,18 @@ class InferenceEngine:
             if shardings is not None:
                 params = jax.device_put(params, shardings)
             self.params = jax.tree_util.tree_map(jnp.asarray, params)
-            cache = init_cache(cfg, slots, self.max_len, dtype=cache_dtype)
+            cache = self._init_cache(cache_dtype)
             cs = cache_shardings(cache, mesh)
             self.cache = (jax.device_put(cache, cs) if cs is not None
                           else cache)
             self._build_programs()
+
+    def _init_cache(self, dtype=None):
+        if self.kv_layout == "paged":
+            return init_paged_cache(self.cfg, self.slots, self.max_len,
+                                    self.block_size, self.num_blocks,
+                                    dtype=dtype)
+        return init_cache(self.cfg, self.slots, self.max_len, dtype=dtype)
 
     # --- compiled programs -------------------------------------------------
 
@@ -150,6 +217,46 @@ class InferenceEngine:
         lengths = cache.lengths + active.astype(jnp.int32)
         return KVCache(k=nk, v=nv, lengths=lengths), toks
 
+    def _paged_prefill_fn(self, params, cache, block_row, tokens, slot,
+                          chunk_start, chunk_len, temperature, top_p, seed):
+        """One prefill CHUNK: (1, bucket) tokens at absolute positions
+        ``chunk_start + [0, chunk_len)`` written through the slot's block
+        ``block_row`` (blocks_per_slot,); pad positions past ``chunk_len``
+        divert to null block 0 (unlike the ring path nothing may scribble
+        past the slot's allocation). Returns the updated cache and a token
+        sampled from the chunk's last real position — meaningful on the
+        FINAL chunk (the host loop discards the rest: intermediate chunks'
+        last logits predict tokens the prompt already contains)."""
+        valid = (jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+                 < chunk_len)
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens, cache.k, cache.v, chunk_start[None],
+            block_tables=block_row[None, :], write_valid=valid,
+            method="forward_with_cache")
+        lengths = jax.lax.dynamic_update_slice(
+            cache.lengths, (chunk_start + chunk_len)[None], (slot,))
+        last = jax.lax.dynamic_slice_in_dim(
+            logits[0], chunk_len - 1, 1, 0)[0].astype(jnp.float32)
+        tok = sample_token(last, slot_key(seed, jnp.int32(0)),
+                           temperature, top_p, self.top_k)
+        return PagedKVCache(k=nk, v=nv, lengths=lengths), tok
+
+    def _paged_decode_fn(self, params, cache, block_tables, tokens, active,
+                         temperature, top_p, seeds, steps):
+        """One token for every slot through the block tables; inactive
+        slots still run (static shapes) but their write diverts to the
+        null block and their lengths do not advance."""
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens[:, None], cache.k, cache.v,
+            cache.lengths, block_tables=block_tables,
+            write_valid=active[:, None], method="forward_with_cache")
+        last = logits[:, 0].astype(jnp.float32)
+        keys = jax.vmap(slot_key)(seeds, steps)
+        toks = jax.vmap(sample_token, in_axes=(0, 0, 0, 0, None))(
+            last, keys, temperature, top_p, self.top_k)
+        lengths = cache.lengths + active.astype(jnp.int32)
+        return PagedKVCache(k=nk, v=nv, lengths=lengths), toks
+
     def _build_programs(self):
         p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
         scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
@@ -157,10 +264,26 @@ class InferenceEngine:
         slots_i = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
         slots_f = jax.ShapeDtypeStruct((self.slots,), jnp.float32)
         slots_b = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
+        self._prefill = {}
+        if self.kv_layout == "paged":
+            tables_abs = jax.ShapeDtypeStruct(
+                (self.slots, self.max_blocks_per_slot), jnp.int32)
+            row_abs = jax.ShapeDtypeStruct((self.max_blocks_per_slot,),
+                                           jnp.int32)
+            self._decode = jax.jit(
+                self._paged_decode_fn, donate_argnums=(1,)).lower(
+                p_abs, c_abs, tables_abs, slots_i, slots_b, slots_f,
+                slots_f, slots_i, slots_i).compile()
+            for b in self.prefill_buckets:
+                tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
+                self._prefill[b] = jax.jit(
+                    self._paged_prefill_fn, donate_argnums=(1,)).lower(
+                    p_abs, c_abs, row_abs, tok_abs, scalar_i, scalar_i,
+                    scalar_i, scalar_f, scalar_f, scalar_i).compile()
+            return
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,)).lower(
             p_abs, c_abs, slots_i, slots_b, slots_f, slots_f, slots_i,
             slots_i).compile()
-        self._prefill = {}
         for b in self.prefill_buckets:
             tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
             self._prefill[b] = jax.jit(
@@ -170,25 +293,78 @@ class InferenceEngine:
 
     # --- host API ----------------------------------------------------------
 
-    def prefill(self, slot: int, token_ids, temperature: float = 0.0,
-                top_p: float = 1.0, seed: int = 0) -> int:
-        """Prompt into ``slot``; returns the first generated token id."""
+    def prefill(self, slot: int, token_ids, block_row=None,
+                temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
+                stop_check: Optional[Callable[[], bool]] = None,
+                on_chunk: Optional[Callable[[], None]] = None
+                ) -> Optional[int]:
+        """Prompt into ``slot``; returns the first generated token id.
+
+        Ring layout: the prompt must fit the largest bucket (one shot).
+        Paged layout: ``block_row`` (blocks_per_slot,) is the slot's block
+        table row from the scheduler's allocator, and prompts LONGER than
+        the largest bucket stream through it in chunks of that bucket size
+        (the last chunk picks its best-fit bucket). ``on_chunk`` fires after
+        every finished chunk; between chunks ``stop_check`` is consulted —
+        if it returns True the prefill stops cleanly AFTER the current chunk
+        and returns None (caller frees the blocks and reports the request
+        unserved: the drain-lifecycle contract for mid-prompt signals).
+        """
         ids = np.asarray(token_ids, np.int32).reshape(-1)
         n = ids.size
-        if not 0 < n <= self.prefill_buckets[-1]:
-            raise ValueError(f"prompt length {n} outside "
-                             f"(0, {self.prefill_buckets[-1]}]")
-        bucket = next(b for b in self.prefill_buckets if b >= n)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = ids
-        self.cache, tok = self._prefill[bucket](
-            self.params, self.cache, padded, np.int32(slot), np.int32(n),
-            np.float32(temperature), np.float32(top_p), np.int32(seed))
+        if self.kv_layout != "paged":
+            if not 0 < n <= self.prefill_buckets[-1]:
+                raise ValueError(f"prompt length {n} outside "
+                                 f"(0, {self.prefill_buckets[-1]}]")
+            bucket = next(b for b in self.prefill_buckets if b >= n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = ids
+            self.cache, tok = self._prefill[bucket](
+                self.params, self.cache, padded, np.int32(slot), np.int32(n),
+                np.float32(temperature), np.float32(top_p), np.int32(seed))
+            return int(tok)
+        if not 0 < n <= self.max_len:
+            raise ValueError(f"prompt length {n} outside (0, {self.max_len}]")
+        if block_row is None:
+            raise ValueError("paged prefill requires the slot's block_row")
+        row = np.asarray(block_row, np.int32).reshape(-1)
+        if row.shape[0] != self.max_blocks_per_slot:
+            raise ValueError(f"block_row has {row.shape[0]} entries, "
+                             f"expected {self.max_blocks_per_slot}")
+        chunk = self.prefill_buckets[-1]
+        start, tok = 0, None
+        while start < n:
+            m = min(chunk, n - start)
+            bucket = next(b for b in self.prefill_buckets if b >= m)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :m] = ids[start:start + m]
+            self.cache, tok = self._prefill[bucket](
+                self.params, self.cache, row, padded, np.int32(slot),
+                np.int32(start), np.int32(m), np.float32(temperature),
+                np.float32(top_p), np.int32(seed))
+            start += m
+            if on_chunk is not None:
+                on_chunk()
+            if start < n and stop_check is not None and stop_check():
+                return None  # interrupted between chunks; request unserved
         return int(tok)
 
-    def decode_step(self, tokens, active, temperature, top_p, seeds, steps
-                    ) -> np.ndarray:
-        """One decode iteration over all slots; host arrays in/out."""
+    def decode_step(self, tokens, active, temperature, top_p, seeds, steps,
+                    block_tables=None) -> np.ndarray:
+        """One decode iteration over all slots; host arrays in/out. The
+        paged layout additionally takes the scheduler's (slots,
+        blocks_per_slot) block tables."""
+        if self.kv_layout == "paged":
+            if block_tables is None:
+                raise ValueError("paged decode requires block_tables")
+            self.cache, toks = self._decode(
+                self.params, self.cache,
+                np.asarray(block_tables, np.int32),
+                np.asarray(tokens, np.int32), np.asarray(active, bool),
+                np.asarray(temperature, np.float32),
+                np.asarray(top_p, np.float32),
+                np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
+            return np.asarray(toks)
         self.cache, toks = self._decode(
             self.params, self.cache,
             np.asarray(tokens, np.int32), np.asarray(active, bool),
@@ -200,8 +376,7 @@ class InferenceEngine:
     def reset(self) -> None:
         """Zero all slot lengths (the buffers' stale contents are masked)."""
         with use_mesh(self.mesh):
-            cache = init_cache(self.cfg, self.slots, self.max_len,
-                               dtype=self.cache.k[0].dtype)
+            cache = self._init_cache(dtype=self.cache.k[0].dtype)
             cs = cache_shardings(cache, self.mesh)
             self.cache = (jax.device_put(cache, cs) if cs is not None
                           else cache)

@@ -1,13 +1,28 @@
-"""Static-shape GQA-aware KV slot cache.
+"""Static-shape GQA-aware KV caches: per-slot ring buffers and paged blocks.
 
-One pair of head-major ring buffers per layer, ``[slots, kv_heads, max_len,
-head_dim]`` — KV heads at their native (grouped) count, mirroring the
-training attention's no-repeat_kv einsum, so the cache is ``n_heads /
-n_kv_heads`` times smaller than a repeated-head layout. ``slots`` is the
+Two layouts share one contract (fixed-shape pytree in, pytree out, buffers
+donatable by the jitted step):
+
+**Ring** (:class:`KVCache`) — one pair of head-major buffers per layer,
+``[slots, kv_heads, max_len, head_dim]``. ``slots`` is the
 continuous-batching dimension: each slot holds one in-flight request's
 prefix, and the per-slot ``lengths`` vector is both the decode position
 offset and the attention-mask boundary (ops/attention.py
-``cached_attention``).
+``cached_attention``). Simple, but every slot reserves ``max_len``
+positions: long-context configs strand most of HBM on empty reservation.
+
+**Paged** (:class:`PagedKVCache`, vLLM's PagedAttention layout, Kwon et al.
+2023) — one GLOBAL block pool per layer, ``[num_blocks, kv_heads,
+block_size, head_dim]``, plus a host-owned int32 block table per slot
+mapping logical block position -> pool block. A request only occupies the
+blocks its actual ``prompt + max_new_tokens`` needs, so at a fixed HBM
+budget far more requests fit concurrently. Block 0 is the reserved
+null/scratch block: free block-table entries point at it, and writes from
+masked positions (bucket padding, inactive decode slots) are redirected
+into it, so a static-shape step never scribbles on another request's
+blocks. The block allocator lives host-side in the scheduler
+(inference/scheduler.py ``BlockAllocator``); the device only ever sees the
+pool and the tables.
 
 Everything is a fixed-shape pytree argument (flax ``struct``), NOT a flax
 mutable collection: the jitted decode step takes the cache in and returns it
@@ -17,7 +32,9 @@ serving tensor.
 
 Sharding under the training mesh (parallel/mesh.py): ``kv_heads`` rides the
 'tensor' axis exactly like the wk/wv projections that produce it
-(parallel/sharding.py LOGICAL_RULES), slots/positions stay replicated.
+(parallel/sharding.py LOGICAL_RULES) in BOTH layouts (it is dim 1 of the
+ring buffer and of the block pool alike); slots/blocks/positions stay
+replicated.
 """
 
 from typing import Optional, Tuple
@@ -58,6 +75,78 @@ def init_cache(cfg: TransformerConfig, slots: int, max_len: int,
                    lengths=jnp.zeros((slots,), jnp.int32))
 
 
+class PagedKVCache(struct.PyTreeNode):
+    """Per-layer (num_blocks, kv_heads, block_size, head_dim) pools + per-slot
+    fill counts. The block tables stay HOST-side (scheduler) and are passed
+    into each compiled step as a plain int32 argument — they are tiny
+    (slots x blocks_per_slot) and change at admission/eviction, not per
+    token, so shipping them per call costs nothing while keeping the donated
+    device state to the pools themselves."""
+
+    k: Tuple[jax.Array, ...]  # length n_layers
+    v: Tuple[jax.Array, ...]
+    lengths: jax.Array        # (slots,) int32 tokens written per slot
+
+    @property
+    def slots(self) -> int:
+        return self.lengths.shape[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k[0].shape[0]
+
+    @property
+    def block_size(self) -> int:
+        return self.k[0].shape[2]
+
+
+def blocks_per_slot(max_len: int, block_size: int) -> int:
+    """Block-table row length covering ``max_len`` positions."""
+    return -(-max_len // block_size)
+
+
+def init_paged_cache(cfg: TransformerConfig, slots: int, max_len: int,
+                     block_size: int, num_blocks: Optional[int] = None,
+                     dtype=None) -> PagedKVCache:
+    """Zero-filled block pool. ``num_blocks`` defaults to full reservation
+    parity with the ring layout (slots * ceil(max_len/block_size)) plus the
+    null block — the interesting configs pass FEWER blocks than that and let
+    the scheduler admit by actual per-request need instead."""
+    dtype = cfg.dtype if dtype is None else dtype
+    if num_blocks is None:
+        num_blocks = slots * blocks_per_slot(max_len, block_size) + 1
+    if num_blocks < 2:
+        raise ValueError(f"num_blocks {num_blocks} < 2: block 0 is the "
+                         f"reserved null block, at least one usable block "
+                         f"is required")
+    shape = (num_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+    return PagedKVCache(
+        k=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)),
+        v=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)),
+        lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def write_paged_kv(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
+                   start: jax.Array, valid: jax.Array) -> jax.Array:
+    """Scatter ``new`` (B, K, S, D) into the block ``pool`` (N, K, bs, D) at
+    each slot's positions ``start[b] + [0, S)``, translated through
+    ``block_tables`` (B, blocks_per_slot). Only the NEW tokens move — one
+    (B*S)-row scatter per call, never the whole cache. Positions with
+    ``valid`` (B, S) False (bucket padding past the prompt, inactive decode
+    slots) are redirected into null block 0, so a static-shape write can
+    never land in another request's blocks. Valid positions map to distinct
+    (block, offset) pairs (the allocator hands each slot disjoint blocks),
+    so the scatter is collision-free where it matters."""
+    bs = pool.shape[2]
+    b, k, s, d = new.shape
+    pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]   # (B, S)
+    idx = jnp.clip(pos // bs, 0, block_tables.shape[1] - 1)
+    blk = jnp.where(valid, jnp.take_along_axis(block_tables, idx, axis=1), 0)
+    off = pos % bs
+    upd = jnp.transpose(new, (0, 2, 1, 3)).reshape(b * s, k, d)
+    return pool.at[blk.reshape(-1), :, off.reshape(-1), :].set(upd)
+
+
 def write_slot_kv(buf: jax.Array, new: jax.Array,
                   start: jax.Array) -> jax.Array:
     """Write ``new`` (B, K, S, D) into ``buf`` (B, K, T, D) at each slot's
@@ -71,15 +160,17 @@ def write_slot_kv(buf: jax.Array, new: jax.Array,
 
 
 def cache_pspec() -> P:
-    """(slots, kv_heads, max_len, head_dim): slots replicated — every device
-    decodes every request, only the heads shard — kv_heads on 'tensor',
-    matching the wk/wv kernels that fill the buffer."""
+    """(slots|blocks, kv_heads, positions, head_dim): slots/blocks replicated
+    — every device decodes every request — only the heads shard: kv_heads
+    on 'tensor', matching the wk/wv kernels that fill the buffer. The spec
+    serves BOTH layouts because the paged pool keeps kv_heads at dim 1."""
     return P(None, "tensor", None, None)
 
 
-def cache_shardings(cache: KVCache, mesh) -> Optional[KVCache]:
-    """NamedSharding pytree for ``cache`` on ``mesh`` (None -> None), with
-    the same divisibility degrade as the param shardings."""
+def cache_shardings(cache, mesh):
+    """NamedSharding pytree for a :class:`KVCache` or :class:`PagedKVCache`
+    on ``mesh`` (None -> None), with the same divisibility degrade as the
+    param shardings."""
     if mesh is None:
         return None
     from ..parallel.sharding import _fit_spec
@@ -87,7 +178,7 @@ def cache_shardings(cache: KVCache, mesh) -> Optional[KVCache]:
     def shard(a):
         return NamedSharding(mesh, _fit_spec(cache_pspec(), a.shape, mesh))
 
-    return KVCache(
+    return type(cache)(
         k=tuple(shard(a) for a in cache.k),
         v=tuple(shard(a) for a in cache.v),
         lengths=NamedSharding(mesh, P(None)),

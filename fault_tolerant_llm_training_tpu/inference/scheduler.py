@@ -8,11 +8,23 @@ iteration. All shapes stay static: "admission" is a prefill into one slot of
 the fixed (slots, ...) cache, "eviction" is host bookkeeping plus the mask
 bit in the decode step.
 
+Under the engine's paged KV layout (the default) the scheduler also owns
+the :class:`BlockAllocator`: admission is gated by FREE BLOCK COUNT —
+``ceil((prompt + max_new_tokens) / block_size)`` blocks per request — not
+just by a free slot, so a long-context cache no longer reserves ``max_len``
+per slot and far more requests fit the same HBM; eviction frees the blocks
+for the next admission. A request whose blocks aren't available yet simply
+waits at the head of the queue (FIFO, no starvation) — exhaustion queues,
+it never crashes.
+
 The scheduler is also the drain point for the fault-tolerant serving
 lifecycle: ``stop_admission()`` (serve.py calls it when a SIGUSR1/SIGTERM
 flag fires) freezes the queue while active slots run to completion, so
 in-flight requests finish and queued ones are reported unserved — the
-serving analogue of the trainer's save-on-signal exit policy.
+serving analogue of the trainer's save-on-signal exit policy. Chunked
+prefills consult ``stop_check`` between chunks, so a signal that lands
+mid-prompt finishes the current chunk only, frees the request's blocks and
+reports it unserved — the drain stays exact even for long prompts.
 """
 
 import dataclasses
@@ -23,6 +35,51 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs.registry import MetricRegistry, default_registry
+
+
+class BlockAllocator:
+    """Host-side free list over the paged cache's block pool.
+
+    Block 0 is the reserved null/scratch block (inference/kv_cache.py):
+    free block-table entries point at it and masked writes divert into it,
+    so it is never handed out. ``free()`` refuses double-frees — an
+    allocator bug corrupting two requests' caches should fail loudly, not
+    silently cross-wire their KV.
+    """
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # LIFO: reuse warm
+        self._used: set = set()
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1  # block 0 reserved
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._used)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None if fewer than n are free (caller queues)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._used.update(blocks)
+        return blocks
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(f"double free of block {b}")
+            self._used.remove(b)
+            self._free.append(b)
 
 
 @dataclasses.dataclass
@@ -76,7 +133,8 @@ class Scheduler:
 
     def __init__(self, engine, eos_token_id: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 registry: Optional[MetricRegistry] = None):
+                 registry: Optional[MetricRegistry] = None,
+                 stop_check: Optional[Callable[[], bool]] = None):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -87,6 +145,18 @@ class Scheduler:
         self.iterations = 0
         self.max_concurrent = 0
         self.step_seconds: List[float] = []  # decode-iteration wall times
+        # Drain probe consulted BETWEEN prefill chunks (serve.py passes the
+        # signal flag) so a mid-prompt SIGUSR1/SIGTERM aborts cleanly at a
+        # chunk boundary; run(stop=...) installs its callable here too.
+        self.stop_check = stop_check
+        self.kv_layout = getattr(engine, "kv_layout", "ring")
+        self.prefill_chunks = 0
+        self.max_block_utilization = 0.0
+        if self.kv_layout == "paged":
+            self.allocator = BlockAllocator(engine.num_blocks)
+            self.block_tables = np.zeros(
+                (engine.slots, engine.max_blocks_per_slot), np.int32)
+            self._slot_blocks: Dict[int, List[int]] = {}
         # /metrics surface (obs/registry.py): serve.py --metrics-port scrapes
         # these live while the batching loop runs.
         r = registry or default_registry()
@@ -108,8 +178,23 @@ class Scheduler:
                                 "Requests waiting for a free slot")
         self._m_tps = r.gauge("ftl_serve_tokens_per_sec",
                               "Aggregate decode throughput (running)")
+        self._m_blocks_free = r.gauge(
+            "ftl_serve_kv_blocks_free",
+            "Free KV cache blocks in the paged pool (block 0 excluded)")
+        self._m_block_util = r.gauge(
+            "ftl_serve_kv_block_utilization",
+            "Allocated / usable KV cache blocks (0-1)")
+        self._m_chunks = r.counter(
+            "ftl_serve_prefill_chunks_total",
+            "Prefill chunks executed (chunked long-prompt prefill)")
+        if self.kv_layout == "paged":
+            self._m_blocks_free.set(self.allocator.free_count)
 
     # --- queue management --------------------------------------------------
+
+    def _blocks_needed(self, request: Request) -> int:
+        bs = self.engine.block_size
+        return -(-(len(request.prompt) + request.max_new_tokens) // bs)
 
     def submit(self, request: Request) -> None:
         if len(request.prompt) + request.max_new_tokens > self.engine.max_len:
@@ -117,6 +202,12 @@ class Scheduler:
                 f"request {request.id}: prompt {len(request.prompt)} + "
                 f"max_new_tokens {request.max_new_tokens} exceeds the "
                 f"cache max_len {self.engine.max_len}")
+        if (self.kv_layout == "paged"
+                and self._blocks_needed(request) > self.allocator.capacity):
+            raise ValueError(
+                f"request {request.id}: needs {self._blocks_needed(request)} "
+                f"KV blocks but the pool only has "
+                f"{self.allocator.capacity} usable blocks")
         self.queue.append((request, self.clock()))
 
     def stop_admission(self) -> None:
@@ -133,6 +224,11 @@ class Scheduler:
 
     def _finish(self, slot: int, reason: str, done: List[Completion]) -> None:
         st = self.active.pop(slot)
+        if self.kv_layout == "paged":
+            blocks = self._slot_blocks.pop(slot, None)
+            if blocks:
+                self.allocator.free(blocks)
+                self.block_tables[slot] = 0
         c = Completion(request_id=st.request.id,
                        prompt_len=len(st.request.prompt),
                        tokens=list(st.tokens), reason=reason,
@@ -144,14 +240,51 @@ class Scheduler:
         self._m_ttft.observe(c.ttft_seconds)
         self._m_done.labels(reason=reason).inc()
 
+    def _count_chunk(self) -> None:
+        self.prefill_chunks += 1
+        self._m_chunks.inc()
+
+    def _drain_requested(self) -> bool:
+        return self.stop_check is not None and bool(self.stop_check())
+
     def _admit(self, done: List[Completion]) -> None:
         free = [s for s in range(self.engine.slots) if s not in self.active]
         while free and self.queue:
-            req, submitted_at = self.queue.popleft()
+            req, submitted_at = self.queue[0]
+            blocks = None
+            if self.kv_layout == "paged":
+                # admission is by free-BLOCK count, not free-slot count:
+                # the head of the queue waits (FIFO, no starvation) until
+                # eviction frees enough blocks for its actual need.
+                blocks = self.allocator.alloc(self._blocks_needed(req))
+                if blocks is None:
+                    break
+            self.queue.popleft()
             slot = free.pop(0)
-            first = self.engine.prefill(slot, req.prompt,
-                                        temperature=req.temperature,
-                                        top_p=req.top_p, seed=req.seed)
+            if self.kv_layout == "paged":
+                row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
+                row[:len(blocks)] = blocks
+                self.block_tables[slot] = row
+                first = self.engine.prefill(
+                    slot, req.prompt, block_row=row,
+                    temperature=req.temperature, top_p=req.top_p,
+                    seed=req.seed, stop_check=self._drain_requested,
+                    on_chunk=self._count_chunk)
+                if first is None:
+                    # Drain fired mid-prompt: the engine finished the
+                    # current chunk and stopped. Free the blocks, put the
+                    # request back at the head so it is REPORTED unserved,
+                    # and close admission — the drain stays exact.
+                    self.allocator.free(blocks)
+                    self.block_tables[slot] = 0
+                    self.queue.appendleft((req, submitted_at))
+                    self.stop_admission()
+                    return
+                self._slot_blocks[slot] = blocks
+            else:
+                first = self.engine.prefill(slot, req.prompt,
+                                            temperature=req.temperature,
+                                            top_p=req.top_p, seed=req.seed)
             self.active[slot] = _Slot(req, first, submitted_at, self.clock())
             self.max_concurrent = max(self.max_concurrent, len(self.active))
             self._m_tokens.inc()  # the prefill's first token
@@ -169,6 +302,11 @@ class Scheduler:
             self._admit(done)
         self._m_queue.set(len(self.queue))
         self._m_occupancy.set(len(self.active) / max(self.engine.slots, 1))
+        if self.kv_layout == "paged":
+            self._m_blocks_free.set(self.allocator.free_count)
+            util = self.allocator.used_count / max(self.allocator.capacity, 1)
+            self._m_block_util.set(util)
+            self.max_block_utilization = max(self.max_block_utilization, util)
         if not self.active:
             return done
         slots = self.engine.slots
@@ -186,8 +324,13 @@ class Scheduler:
             seeds[s] = st.request.seed
             steps[s] = st.steps
         t0 = self.clock()
-        next_tokens = self.engine.decode_step(tokens, active, temperature,
-                                              top_p, seeds, steps)
+        if self.kv_layout == "paged":
+            next_tokens = self.engine.decode_step(
+                tokens, active, temperature, top_p, seeds, steps,
+                block_tables=self.block_tables)
+        else:
+            next_tokens = self.engine.decode_step(tokens, active, temperature,
+                                                  top_p, seeds, steps)
         step_wall = self.clock() - t0
         self.step_seconds.append(step_wall)
         self._m_decode.observe(step_wall)
@@ -211,6 +354,8 @@ class Scheduler:
             ) -> List[Completion]:
         """Drive until idle; ``stop()`` returning True switches to drain
         mode (finish active, leave the queue). Returns all completions."""
+        if stop is not None and self.stop_check is None:
+            self.stop_check = stop  # also probed between prefill chunks
         while self.pending():
             if stop is not None and self.admission_open and stop():
                 self.stop_admission()
@@ -226,7 +371,7 @@ class Scheduler:
         wall = float(lat.sum())
         tps = generated / wall if wall > 0 else 0.0
         self._m_tps.set(tps)
-        return {
+        out = {
             "iterations": self.iterations,
             "requests_completed": len(self.completed),
             "tokens_generated": int(generated),
@@ -235,4 +380,10 @@ class Scheduler:
             "decode_p95_ms": float(np.percentile(lat, 95) * 1e3),
             "tokens_per_sec": tps,
             "tokens_per_sec_per_slot": tps / max(self.engine.slots, 1),
+            "prefill_chunks": self.prefill_chunks,
         }
+        if self.kv_layout == "paged":
+            out["kv_blocks_total"] = self.allocator.capacity
+            out["kv_blocks_free"] = self.allocator.free_count
+            out["kv_block_utilization_peak"] = self.max_block_utilization
+        return out

@@ -34,7 +34,11 @@ from ..utils.logging import (
     init_logger,
     logger,
 )
-from .engine import InferenceEngine
+from .engine import (
+    DEFAULT_COMPILE_CACHE_DIR,
+    InferenceEngine,
+    enable_compilation_cache,
+)
 from .scheduler import Request, Scheduler
 
 _DEMO_PROMPT = "alpha bravo charlie delta echo"
@@ -67,7 +71,27 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                    help="KV cache length per slot; 0 = model seq_len")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated AOT prefill lengths "
-                        "(default: power-of-two ladder)")
+                        "(default: power-of-two ladder); with the paged "
+                        "layout, longer prompts stream through the largest "
+                        "bucket in chunks instead of being rejected")
+    p.add_argument("--kv-layout", default="paged",
+                   choices=("paged", "ring"),
+                   help="KV cache layout: block-paged pool admitted by "
+                        "free-block count (default), or the legacy "
+                        "max_len-per-slot ring buffers")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="positions per KV block (paged layout)")
+    p.add_argument("--kv-num-blocks", type=int, default=0,
+                   help="total KV pool blocks incl. the null block; 0 = "
+                        "full reservation parity (slots * max_len worth). "
+                        "Set LOWER to serve more slots at the same HBM, "
+                        "admission queues on block exhaustion")
+    p.add_argument("--compile-cache-dir",
+                   default=None,
+                   help="JAX persistent compilation cache directory "
+                        "(default: ~/.cache/fault_tolerant_llm_training_tpu/"
+                        "xla-cache; '' disables). Warm engine builds skip "
+                        "the AOT prefill/decode compiles")
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=1.0)
@@ -104,6 +128,11 @@ def main(argv=None) -> None:
     events.emit_audit(logger, AUDIT_SERVE_START, "start")
 
     with flag.deferred():  # block delivery across compile + Orbax restore
+        cache_dir = (DEFAULT_COMPILE_CACHE_DIR
+                     if args.compile_cache_dir is None
+                     else args.compile_cache_dir)
+        if enable_compilation_cache(cache_dir):
+            logger.info(f"Compilation cache | {cache_dir}")
         tokenizer = load_tokenizer(args.tokenizer_name_or_path)
         vocab = args.vocab_size or tokenizer.vocab_size
         cfg = get_config(args.model, vocab_size=vocab,
@@ -114,16 +143,23 @@ def main(argv=None) -> None:
             args.checkpoint_path, args.checkpoint_job_id, cfg,
             step=args.step, slots=args.slots,
             max_len=args.max_len or None, prefill_buckets=buckets,
-            top_k=args.top_k)
+            top_k=args.top_k, kv_layout=args.kv_layout,
+            kv_block_size=args.kv_block_size,
+            kv_num_blocks=args.kv_num_blocks or None)
         events.emit_audit(
             logger, AUDIT_SERVE_READY_FMT.format(
                 model=args.model, step=engine.restored_step,
                 slots=args.slots),
             "ready", step=engine.restored_step, slots=args.slots,
             model=args.model)
+        # stop_check lets a chunked prefill see the signal BETWEEN chunks:
+        # a mid-prompt SIGUSR1/SIGTERM finishes the current chunk, frees the
+        # request's blocks and reports it unserved — exact drain, any
+        # prompt length.
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
-                                        else tokenizer.eos_token_id))
+                                        else tokenizer.eos_token_id),
+                          stop_check=lambda: flag.signum is not None)
         prompts = (args.prompt or [_DEMO_PROMPT]) * args.repeat
         for i, text in enumerate(prompts):
             sched.submit(Request(
@@ -134,7 +170,10 @@ def main(argv=None) -> None:
 
     drained = False
     while sched.pending():
-        if flag.signum is not None and sched.admission_open:
+        # not admission_open: a chunked prefill may have seen the signal
+        # first (scheduler stop_check) and closed admission itself — the
+        # audit trail must still record the drain exactly once.
+        if flag.signum is not None and not drained:
             events.emit_audit(
                 logger, AUDIT_SERVE_DRAINING_FMT.format(
                     signum=flag.signum, active=len(sched.active)),
@@ -162,6 +201,16 @@ def main(argv=None) -> None:
                     queued=len(sched.queue), done=len(sched.completed)),
                 "step", step=sched.iterations, active=len(sched.active),
                 queued=len(sched.queue), done=len(sched.completed))
+
+    if flag.signum is not None and not drained:
+        # the signal was consumed inside a chunked prefill on the final
+        # iteration — the loop exited before the top-of-loop check ran
+        events.emit_audit(
+            logger, AUDIT_SERVE_DRAINING_FMT.format(
+                signum=flag.signum, active=len(sched.active)),
+            "drain", phase="begin", signum=flag.signum,
+            active=len(sched.active))
+        drained = True
 
     m = sched.metrics()
     logger.info("Serving metrics: %d requests | %d tokens | "

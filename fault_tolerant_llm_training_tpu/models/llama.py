@@ -204,15 +204,45 @@ class Attention(nn.Module):
                 b, s, cfg.kv_heads, dh)
 
         if cache is not None:
-            # Prefill/decode against a per-slot KV ring buffer: q/k/v come
-            # from the SAME projection impl the training forward selects
-            # (fused_qkv or Dense — the fused_rope/bhsd branches are gated
-            # off above, so canonical q/k/v always exist here), RoPE gathers
-            # from the same precomputed table at absolute positions, and the
-            # einsum attention mirrors xla_attention's numerics — cached
-            # decode logits bit-match the uncached forward
-            # (tests/test_inference.py).
-            from ..inference.kv_cache import write_slot_kv
+            # Prefill/decode against a KV cache: q/k/v come from the SAME
+            # projection impl the training forward selects (fused_qkv or
+            # Dense — the fused_rope/bhsd branches are gated off above, so
+            # canonical q/k/v always exist here), RoPE gathers from the same
+            # precomputed table at absolute positions, and the einsum
+            # attention mirrors xla_attention's numerics — cached decode
+            # logits bit-match the uncached forward (tests/test_inference.py,
+            # tests/test_paged_kv.py). Two cache layouts, dispatched on the
+            # tuple arity (inference/kv_cache.py):
+            #   (k, v, offsets)                 per-slot ring buffers
+            #   (k, v, tables, offsets, valid)  paged block pool
+            from ..inference.kv_cache import write_paged_kv, write_slot_kv
+            if len(cache) == 5:
+                k_pool, v_pool, block_tables, offsets, write_valid = cache
+                # Table rows cover ceil(max_len/bs) blocks; rope rows are
+                # per-position, so the (possibly longer) gathered T only
+                # adds masked tail rows — values at shared positions are
+                # identical to the ring path's table.
+                t = block_tables.shape[1] * k_pool.shape[2]
+                cos, sin = precompute_rope(dh, t, cfg.rope_theta)
+                pos = (offsets[:, None]
+                       + jnp.arange(s, dtype=jnp.int32)[None, :])
+                q = apply_rope(q, cos, sin, positions=pos)
+                k = apply_rope(k, cos, sin, positions=pos)
+                # Scatter ONLY the new tokens through the block table
+                # BEFORE attending (so they attend to themselves); invalid
+                # positions (pad/inactive) divert to null block 0.
+                k_pool = write_paged_kv(
+                    k_pool, jnp.transpose(k, (0, 2, 1, 3)), block_tables,
+                    offsets, write_valid)
+                v_pool = write_paged_kv(
+                    v_pool, jnp.transpose(v, (0, 2, 1, 3)), block_tables,
+                    offsets, write_valid)
+                from ..ops.attention import paged_cached_attention
+                out = paged_cached_attention(q, k_pool, v_pool,
+                                             block_tables, offsets)
+                out = out.reshape(b, s, cfg.n_heads * dh)
+                return (nn.Dense(cfg.dim, name="wo", **dense)(out),
+                        (k_pool, v_pool))
             k_cache, v_cache, offsets = cache
             t = k_cache.shape[2]
             cos, sin = precompute_rope(dh, t, cfg.rope_theta)
@@ -457,13 +487,19 @@ class Transformer(nn.Module):
         logits = self.output(self.hidden_states(tokens, positions))
         return constrain(logits, "batch", "seq", "vocab")
 
-    def forward_with_cache(self, tokens, cache_k, cache_v, offsets):
-        """Prefill/decode forward through per-layer KV slot buffers.
+    def forward_with_cache(self, tokens, cache_k, cache_v, offsets,
+                           block_tables=None, write_valid=None):
+        """Prefill/decode forward through per-layer KV caches.
 
         ``tokens`` (B, S) occupy absolute positions ``offsets[b] + [0, S)``;
-        each layer attends against (and appends to) its (B, K, T, D) buffers
-        from ``cache_k``/``cache_v`` (length-n_layers sequences). Loop trunk
-        only — the inference engine converts scan-form checkpoints with
+        each layer attends against (and appends to) its buffers from
+        ``cache_k``/``cache_v`` (length-n_layers sequences). With
+        ``block_tables`` None the buffers are per-slot (B, K, T, D) ring
+        buffers; with ``block_tables`` (B, NB) they are paged (N, K, bs, D)
+        block pools, writes route through the table, and ``write_valid``
+        (B, S) masks which new positions are real (padding/inactive writes
+        divert to null block 0; default: all valid). Loop trunk only — the
+        inference engine converts scan-form checkpoints with
         :func:`unstack_layer_params`. Returns
         ``(logits, (new_cache_k, new_cache_v))``.
         """
@@ -471,10 +507,15 @@ class Transformer(nn.Module):
             raise ValueError(
                 "forward_with_cache requires layer_impl='loop'; convert "
                 "scan-form checkpoints with unstack_layer_params")
+        if block_tables is not None and write_valid is None:
+            write_valid = jnp.ones(tokens.shape, jnp.bool_)
         x = self.embed(tokens)
         new_k, new_v = [], []
         for i, layer in enumerate(self.layers):
-            x, (k_i, v_i) = layer(x, None, (cache_k[i], cache_v[i], offsets))
+            c = ((cache_k[i], cache_v[i], offsets) if block_tables is None
+                 else (cache_k[i], cache_v[i], block_tables, offsets,
+                       write_valid))
+            x, (k_i, v_i) = layer(x, None, c)
             new_k.append(k_i)
             new_v.append(v_i)
         return self.head(x), (tuple(new_k), tuple(new_v))

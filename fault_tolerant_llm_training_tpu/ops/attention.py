@@ -92,6 +92,47 @@ def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     return out.reshape(b, s_q, h, d).astype(q.dtype)
 
 
+def gather_kv_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """Assemble per-slot contiguous KV views from a paged block pool.
+
+    pool:         (N, K, bs, D) global block pool (inference/kv_cache.py
+                  ``PagedKVCache``); block 0 is the null/scratch block.
+    block_tables: (B, NB) int32 — slot b's logical block n lives in pool
+                  block ``block_tables[b, n]``; unallocated entries are 0.
+
+    Returns (B, K, NB*bs, D). One gather per layer: position ``p`` of slot
+    ``b`` is ``pool[block_tables[b, p // bs], :, p % bs]`` — exactly the
+    ring buffer's content for every written position, and null-block/stale
+    content beyond a slot's length, which the caller's length mask zeroes.
+    """
+    g = pool[block_tables]                     # (B, NB, K, bs, D)
+    b, nb, k, bs, d = g.shape
+    return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(b, k, nb * bs, d)
+
+
+def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                           offsets: jnp.ndarray) -> jnp.ndarray:
+    """:func:`cached_attention` against block-paged KV pools.
+
+    Gathers each slot's blocks into the (B, K, T, D) layout via the block
+    table, then runs the EXACT :func:`cached_attention` math on it — same
+    grouped einsum, fp32 softmax, additive ``finfo.min`` mask keyed on
+    ``offsets`` — so on identical cached contents the two paths bit-match:
+    masked gathered positions (null block, stale/freed blocks, positions
+    beyond a slot's length) get ``exp(finfo.min + score) == 0`` probability
+    exactly and contribute exact zeros to the fp32 accumulation, just like
+    the ring buffer's masked tail. This is the portable XLA-level reference
+    of vLLM's PagedAttention: the gather materializes a transient per-call
+    contiguous view instead of a fused block-indexed kernel, which is the
+    right first rung on CPU/XLA and the semantics a later Pallas kernel
+    must reproduce.
+    """
+    return cached_attention(q, gather_kv_blocks(k_pool, block_tables),
+                            gather_kv_blocks(v_pool, block_tables), offsets)
+
+
 def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         impl: str = "auto", causal: bool = True) -> jnp.ndarray:
     """Dispatch to the requested attention implementation."""

@@ -2,12 +2,29 @@
 
 Builds an InferenceEngine (random params by default, or a real checkpoint
 via --checkpoint-path/--checkpoint-job-id), drives the scheduler with
-synthetic concurrent requests, and writes BENCH_decode_<model>_<backend>.json
-with the serving headline numbers: tokens/sec, tokens/sec/slot, and p50/p95
-per-decode-iteration latency.
+synthetic concurrent requests, and writes a BENCH_decode_*.json receipt
+with the serving headline numbers: tokens/sec, tokens/sec/slot, p50/p95
+per-decode-iteration latency, and (paged layout) block-pool utilization.
+
+Two scenarios:
+
+- ``uniform`` (default): N identical requests, the steady-state decode
+  number. Writes BENCH_decode_<model>_<backend>.json.
+- ``long_context``: mixed short/long prompts where the long prompts EXCEED
+  the largest prefill bucket (chunked prefill) and the paged pool holds the
+  SAME cache memory budget as a ring config — the receipt shows the paged
+  layout sustaining more concurrent requests at fixed HBM. Runs BOTH
+  layouts and writes BENCH_decode_paged_<backend>.json.
+
+Engine builds AOT-compile every bucket, so the JAX persistent compilation
+cache is enabled by default (--compile-cache-dir '' disables); the receipt
+records cold-vs-warm build seconds (the warm number is what a restarted
+server actually pays).
 
 Run on the chip:  python scripts/decode_bench.py --model tiny --slots 8
 CPU smoke:        JAX_PLATFORMS=cpu python scripts/decode_bench.py
+Long context:     JAX_PLATFORMS=cpu python scripts/decode_bench.py \
+                      --scenario long_context
 """
 
 import argparse
@@ -19,18 +36,45 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _run_stream(engine, requests, eos=None):
+    """Drive one request list through a fresh Scheduler; returns metrics."""
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Scheduler
+
+    sched = Scheduler(engine, eos_token_id=eos)
+    for r in requests:
+        sched.submit(r)
+    t0 = time.monotonic()
+    sched.run()
+    m = sched.metrics()
+    m["wall_seconds"] = time.monotonic() - t0
+    return m
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="tiny")
     p.add_argument("--vocab-size", type=int, default=0)
     p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
-    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--scenario", default="uniform",
+                   choices=("uniform", "long_context"))
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots (long_context: the RING config's "
+                        "slot count, which sets the cache memory budget)")
     p.add_argument("--max-len", type=int, default=0)
     p.add_argument("--prompt-len", type=int, default=32)
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--requests", type=int, default=16)
     p.add_argument("--warmup-requests", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-layout", default="paged", choices=("paged", "ring"))
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--kv-num-blocks", type=int, default=0)
+    p.add_argument("--prefill-buckets", default="")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="JAX persistent compilation cache ('' disables)")
+    p.add_argument("--no-warm-build", action="store_true",
+                   help="skip the second engine build that measures the "
+                        "warm (cache-hit) build time")
     p.add_argument("--checkpoint-path", default="")
     p.add_argument("--checkpoint-job-id", default="")
     p.add_argument("--out", default="")
@@ -41,64 +85,97 @@ def main():
     import numpy as np
 
     from fault_tolerant_llm_training_tpu.data.tokenizer import load_tokenizer
-    from fault_tolerant_llm_training_tpu.inference.engine import InferenceEngine
-    from fault_tolerant_llm_training_tpu.inference.scheduler import (
-        Request,
-        Scheduler,
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        DEFAULT_COMPILE_CACHE_DIR,
+        InferenceEngine,
+        enable_compilation_cache,
     )
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Request
     from fault_tolerant_llm_training_tpu.models.configs import get_config
     from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    cache_dir = (DEFAULT_COMPILE_CACHE_DIR if args.compile_cache_dir is None
+                 else args.compile_cache_dir)
+    cache_on = enable_compilation_cache(cache_dir)
 
     vocab = args.vocab_size or load_tokenizer("byte").vocab_size
     cfg = get_config(args.model, vocab_size=vocab,
                      layer_impl=args.layer_impl)
-    max_len = args.max_len or min(cfg.seq_len,
-                                  args.prompt_len + args.max_new_tokens)
+    backend = jax.default_backend()
+    rng = np.random.default_rng(args.seed)
 
-    t0 = time.monotonic()
-    if args.checkpoint_path:
-        engine = InferenceEngine.from_checkpoint(
-            args.checkpoint_path, args.checkpoint_job_id, cfg,
-            slots=args.slots, max_len=max_len)
-    else:
+    params = None
+    if not args.checkpoint_path:
         model = Transformer(cfg)
         params = model.init(jax.random.PRNGKey(args.seed),
                             jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
-        engine = InferenceEngine(cfg, params, slots=args.slots,
-                                 max_len=max_len)
-    build_seconds = time.monotonic() - t0
 
-    rng = np.random.default_rng(args.seed)
+    def build(max_len, **kw):
+        t0 = time.monotonic()
+        if args.checkpoint_path:
+            eng = InferenceEngine.from_checkpoint(
+                args.checkpoint_path, args.checkpoint_job_id, cfg,
+                max_len=max_len, **kw)
+        else:
+            eng = InferenceEngine(cfg, params, max_len=max_len, **kw)
+        return eng, time.monotonic() - t0
 
-    def _requests(n, tag):
+    def reqs(specs, tag):
         return [Request(id=f"{tag}{i}",
-                        prompt=rng.integers(3, vocab,
-                                            size=args.prompt_len).tolist(),
-                        max_new_tokens=args.max_new_tokens)
-                for i in range(n)]
+                        prompt=rng.integers(3, vocab, size=pl).tolist(),
+                        max_new_tokens=gen)
+                for i, (pl, gen) in enumerate(specs)]
+
+    if args.scenario == "long_context":
+        result = _long_context(args, build, reqs)
+    else:
+        result = _uniform(args, build, reqs, backend)
+    result["compile_cache"] = cache_dir if cache_on else ""
+
+    print(json.dumps(result))
+    default_name = ("BENCH_decode_paged" if args.scenario == "long_context"
+                    else f"BENCH_decode_{args.model}")
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f"{default_name}_{backend}.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out}")
+
+
+def _uniform(args, build, reqs, backend):
+    buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
+               if args.prefill_buckets else None)
+    max_len = args.max_len or args.prompt_len + args.max_new_tokens
+    kw = dict(slots=args.slots, prefill_buckets=buckets,
+              kv_layout=args.kv_layout)
+    if args.kv_layout == "paged":
+        kw.update(kv_block_size=args.kv_block_size,
+                  kv_num_blocks=args.kv_num_blocks or None)
+    engine, build_seconds = build(max_len, **kw)
+    warm_seconds = None
+    if not args.no_warm_build:
+        # second build from the same process: every AOT compile hits the
+        # persistent cache — the restart cost a real redeploy pays
+        engine = None
+        engine, warm_seconds = build(max_len, **kw)
 
     # warmup: touch every prefill bucket/decode program once off the clock
-    warm = Scheduler(engine, eos_token_id=None)
-    for r in _requests(max(args.warmup_requests, 1), "warm"):
-        warm.submit(r)
-    warm.run()
+    _run_stream(engine, reqs([(args.prompt_len, args.max_new_tokens)]
+                             * max(args.warmup_requests, 1), "warm"))
     engine.reset()
+    m = _run_stream(engine, reqs([(args.prompt_len, args.max_new_tokens)]
+                                 * args.requests, "req"))
 
-    sched = Scheduler(engine, eos_token_id=None)
-    for r in _requests(args.requests, "req"):
-        sched.submit(r)
-    t0 = time.monotonic()
-    sched.run()
-    wall = time.monotonic() - t0
-    m = sched.metrics()
-
-    backend = jax.default_backend()
     result = {
         "metric": (f"decode tokens/sec/slot ({args.model}, {args.slots} "
                    f"slots, prompt {args.prompt_len}, gen "
-                   f"{args.max_new_tokens}, backend {backend})"),
+                   f"{args.max_new_tokens}, kv {args.kv_layout}, backend "
+                   f"{backend})"),
         "value": round(m["tokens_per_sec_per_slot"], 1),
         "unit": "tokens/sec/slot",
+        "kv_layout": args.kv_layout,
         "tokens_per_sec": round(m["tokens_per_sec"], 1),
         "decode_p50_ms": round(m["decode_p50_ms"], 3),
         "decode_p95_ms": round(m["decode_p95_ms"], 3),
@@ -106,18 +183,92 @@ def main():
         "tokens_generated": m["tokens_generated"],
         "max_concurrent": m["max_concurrent"],
         "iterations": m["iterations"],
-        "wall_seconds": round(wall, 3),
+        "wall_seconds": round(m["wall_seconds"], 3),
         "engine_build_seconds": round(build_seconds, 3),
+        "engine_build_seconds_warm": (None if warm_seconds is None
+                                      else round(warm_seconds, 3)),
         "restored_step": engine.restored_step,
     }
-    print(json.dumps(result))
-    out = args.out or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        f"BENCH_decode_{args.model}_{backend}.json")
-    with open(out, "w") as f:
-        json.dump(result, f, indent=1)
-        f.write("\n")
-    print(f"wrote {out}")
+    if args.kv_layout == "paged":
+        result["kv_block_size"] = engine.block_size
+        result["kv_blocks_total"] = engine.num_blocks - 1
+        result["kv_block_utilization_peak"] = round(
+            m["kv_block_utilization_peak"], 3)
+    return result
+
+
+def _long_context(args, build, reqs):
+    """Mixed short/long traffic, ring vs paged at the SAME cache budget.
+
+    The budget is the ring config's reservation: slots * max_len cached
+    positions. The paged pool gets exactly that many positions
+    (budget/block_size usable blocks + the null block) but 4x the slots —
+    concurrency is then bounded by actual per-request need (admission by
+    free-block count), not by reservation. Long prompts exceed the paged
+    config's largest bucket (64), so they exercise chunked prefill; the
+    ring config needs its full bucket ladder (largest = max_len) to accept
+    them at all.
+    """
+    import jax
+
+    max_len = args.max_len or 256
+    bs = args.kv_block_size
+    budget_positions = args.slots * max_len
+    short, long_ = (24, 16), (160, 32)  # (prompt, gen)
+    specs = [short if i % 2 == 0 else long_ for i in range(args.requests)]
+
+    paged_kw = dict(slots=args.slots * 4, prefill_buckets=(16, 32, 64),
+                    kv_layout="paged", kv_block_size=bs,
+                    kv_num_blocks=budget_positions // bs + 1)
+    ring_kw = dict(slots=args.slots, kv_layout="ring")
+
+    paged, paged_build = build(max_len, **paged_kw)
+    _run_stream(paged, reqs(specs[:2], "warm"))
+    paged.reset()
+    pm = _run_stream(paged, reqs(specs, "req"))
+    paged_summary = {
+        "slots": paged_kw["slots"],
+        "prefill_buckets": list(paged_kw["prefill_buckets"]),
+        "kv_block_size": bs,
+        "kv_blocks_total": pm["kv_blocks_total"],
+        "tokens_per_sec": round(pm["tokens_per_sec"], 1),
+        "max_concurrent": pm["max_concurrent"],
+        "kv_block_utilization_peak": round(
+            pm["kv_block_utilization_peak"], 3),
+        "prefill_chunks": pm["prefill_chunks"],
+        "decode_p50_ms": round(pm["decode_p50_ms"], 3),
+        "requests": pm["requests_completed"],
+        "engine_build_seconds": round(paged_build, 3),
+    }
+    paged = None  # free the pool before the ring engine builds
+
+    ring, ring_build = build(max_len, **ring_kw)
+    _run_stream(ring, reqs(specs[:2], "warm"))
+    ring.reset()
+    rm = _run_stream(ring, reqs(specs, "req"))
+    ring_summary = {
+        "slots": args.slots,
+        "tokens_per_sec": round(rm["tokens_per_sec"], 1),
+        "max_concurrent": rm["max_concurrent"],
+        "decode_p50_ms": round(rm["decode_p50_ms"], 3),
+        "requests": rm["requests_completed"],
+        "engine_build_seconds": round(ring_build, 3),
+    }
+
+    return {
+        "metric": (f"long-context paged decode tokens/sec ({args.model}, "
+                   f"mixed prompts {short[0]}/{long_[0]}, max_len "
+                   f"{max_len}, cache budget {budget_positions} positions, "
+                   f"backend {jax.default_backend()})"),
+        "value": paged_summary["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "cache_budget_positions": budget_positions,
+        "long_prompt_exceeds_largest_bucket": long_[0] > 64,
+        "paged": paged_summary,
+        "ring": ring_summary,
+        "concurrency_gain": round(
+            pm["max_concurrent"] / max(rm["max_concurrent"], 1), 2),
+    }
 
 
 if __name__ == "__main__":

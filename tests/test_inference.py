@@ -105,13 +105,20 @@ def test_engine_greedy_matches_uncached_autoregression(layer_impl):
         ref.append(tok)
         seq.append(tok)
 
+    # default layout is the paged block pool: the raw engine API needs the
+    # slot's block-table row (the Scheduler's allocator provides it in
+    # production; tests/test_paged_kv.py covers the allocator itself)
     engine = InferenceEngine(cfg, params, slots=2, max_len=32)
-    got = [engine.prefill(0, prompt)]
+    row = np.arange(1, engine.max_blocks_per_slot + 1, dtype=np.int32)
+    tables = np.zeros((2, engine.max_blocks_per_slot), np.int32)
+    tables[0] = row
+    got = [engine.prefill(0, prompt, block_row=row)]
     for step in range(1, N):
         toks = engine.decode_step(
             np.array([got[-1], 0], np.int32), np.array([True, False]),
             np.zeros(2, np.float32), np.ones(2, np.float32),
-            np.zeros(2, np.int32), np.full(2, step, np.int32))
+            np.zeros(2, np.int32), np.full(2, step, np.int32),
+            block_tables=tables)
         got.append(int(toks[0]))
     assert got == ref
 
