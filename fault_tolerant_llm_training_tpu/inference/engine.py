@@ -22,6 +22,32 @@ equivalence testing), and two families of compiled programs —
   takes the scheduler's (slots, blocks_per_slot) block tables as a plain
   host argument each call.
 
+**Speculative decoding** (``spec_k > 0``, paged layout only) adds a second
+model lifecycle inside the engine: a small DRAFT model (its own params,
+its own paged block pool, its own AOT programs) proposes k tokens per
+round, and the target scores all k+1 candidate positions in ONE verify
+pass instead of k+1 decode dispatches —
+
+- **draft-k**: ONE compiled program runs the k+1 chained draft micro-steps
+  in a ``lax.fori_loop`` (feed ``[t_last, d_1 .. d_k]`` at offsets
+  ``L .. L+k``; the final iteration only back-fills d_k's KV so a fully
+  accepted round leaves the draft cache aligned), returning the proposals
+  AND the post-filter distributions they were drawn from as device arrays
+  — the host never syncs mid-round, so a round costs two dispatches total.
+- **verify-k**: ONE compiled program scores the k+1 candidate positions —
+  as chained S=1 micro-steps on the decode program's exact op shapes (see
+  ``_verify_fn`` for why the single (slots, k+1) chunk through
+  :meth:`Transformer.verify_with_cache` is numerically equivalent but not
+  bitwise-pinned) — then the vectorized accept/resample kernel
+  (sampler.py ``spec_accept``). Acceptance commits the prefix by setting
+  the cache length to ``offset + accepted + 1``; the rejected suffix
+  needs no device rollback — its stale KV sits past the committed length,
+  masked by attention and overwritten next round. Greedy acceptance is
+  exact argmax matching, so greedy speculative streams are BIT-identical
+  to the non-speculative path (tests/test_spec_decode.py); sampled slots
+  use distribution-preserving rejection sampling against the same
+  per-slot temperature/top-p/top-k.
+
 Checkpoints restore through the existing cross-topology
 ``checkpoint/manager.py`` path (:meth:`InferenceEngine.from_checkpoint`):
 the abstract TrainState is rebuilt exactly as the trainer builds it, params
@@ -35,8 +61,8 @@ table values at absolute positions, and an attention kernel mirroring
 (tests/test_inference.py).
 """
 
+import functools
 import logging
-import os
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -47,6 +73,13 @@ from ..models.configs import TransformerConfig
 from ..models.llama import Transformer, unstack_layer_params
 from ..parallel.mesh import use_mesh
 from ..parallel.sharding import param_shardings
+# Re-exported for backward compatibility: serve.py, scripts/decode_bench.py
+# and tests imported these from here before the cache wiring moved to
+# utils/ (so the trainer can use it without importing inference/).
+from ..utils.compile_cache import (  # noqa: F401
+    DEFAULT_COMPILE_CACHE_DIR,
+    enable_compilation_cache,
+)
 from .kv_cache import (
     KVCache,
     PagedKVCache,
@@ -55,42 +88,16 @@ from .kv_cache import (
     init_cache,
     init_paged_cache,
 )
-from .sampler import sample_token, slot_key
+from .sampler import (
+    draft_key,
+    sample_token,
+    sample_token_with_probs,
+    slot_key,
+    spec_accept,
+    verify_key,
+)
 
 logger = logging.getLogger()
-
-DEFAULT_COMPILE_CACHE_DIR = os.path.join(
-    os.path.expanduser("~"), ".cache", "fault_tolerant_llm_training_tpu",
-    "xla-cache")
-
-
-def enable_compilation_cache(cache_dir: str = DEFAULT_COMPILE_CACHE_DIR
-                             ) -> bool:
-    """Point JAX's persistent compilation cache at ``cache_dir``.
-
-    Engine builds AOT-compile a decode program plus one prefill program per
-    bucket; cold that dominates small-run wall time (16.8 s of the tiny CPU
-    bench), warm it is a disk read. No-ops (returns False) when ``cache_dir``
-    is empty, when the user already configured a cache (the
-    ``JAX_COMPILATION_CACHE_DIR`` env var / prior config.update wins), or on
-    jax versions without the option. Min-compile-time/entry-size floors drop
-    to 0 so even the tiny test programs cache.
-    """
-    if not cache_dir:
-        return False
-    try:
-        if getattr(jax.config, "jax_compilation_cache_dir", None):
-            return True  # already configured (env var or earlier call)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except Exception:  # pragma: no cover - ancient jax
-        return False
-    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
-                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
-        try:
-            jax.config.update(knob, val)
-        except Exception:  # pragma: no cover - knob absent on this jax
-            pass
-    return True
 
 
 def default_prefill_buckets(max_len: int, smallest: int = 16
@@ -127,7 +134,11 @@ class InferenceEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  top_k: int = 0, cache_dtype=None, mesh=None,
                  kv_layout: str = "paged", kv_block_size: int = 16,
-                 kv_num_blocks: Optional[int] = None):
+                 kv_num_blocks: Optional[int] = None,
+                 draft_cfg: Optional[TransformerConfig] = None,
+                 draft_params=None, spec_k: int = 0,
+                 draft_num_blocks: Optional[int] = None,
+                 spec_verify_impl: str = "exact"):
         if kv_layout not in ("paged", "ring"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
         if cfg.layer_impl == "scan":
@@ -141,6 +152,7 @@ class InferenceEngine:
         self.top_k = top_k
         self.kv_layout = kv_layout
         self.restored_step: Optional[int] = None
+        self.draft_restored_step: Optional[int] = None
         buckets = tuple(sorted(set(prefill_buckets
                                    or default_prefill_buckets(self.max_len))))
         if buckets[-1] > self.max_len:
@@ -155,6 +167,48 @@ class InferenceEngine:
                                or slots * self.max_blocks_per_slot + 1)
         self.model = Transformer(cfg)
 
+        # --- speculative decoding: second model lifecycle ------------------
+        self.spec_k = int(spec_k)
+        self.draft_cfg = None
+        self.draft_model = None
+        if self.spec_k:
+            if kv_layout != "paged":
+                raise ValueError("speculative decoding requires the paged "
+                                 "KV layout (masked null-block writes are "
+                                 "what make rejected-suffix rollback free)")
+            if draft_cfg is None or draft_params is None:
+                raise ValueError("spec_k > 0 requires draft_cfg and "
+                                 "draft_params")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}: accept/resample compares the two "
+                    f"models' distributions token-for-token")
+            if not 1 <= self.spec_k < self.max_len:
+                raise ValueError(f"spec_k {spec_k} outside [1, max_len)")
+            if spec_verify_impl not in ("exact", "chunk"):
+                raise ValueError(
+                    f"unknown spec_verify_impl {spec_verify_impl!r}: "
+                    f"'exact' (k+1 chained S=1 micro-steps — greedy streams "
+                    f"bit-identical to the non-speculative path by "
+                    f"construction; the win is dispatch elimination, which "
+                    f"pays on accelerators) or 'chunk' (one (slots, k+1) "
+                    f"forward — additionally batches the verify FLOPs, but "
+                    f"bf16 GEMM accumulation is shape-dependent and a "
+                    f"one-ulp logit near-tie can flip an argmax vs the S=1 "
+                    f"decode program)")
+            self.spec_verify_impl = spec_verify_impl
+            if draft_cfg.layer_impl == "scan":
+                draft_params = unstack_layer_params(draft_params,
+                                                    draft_cfg.n_layers)
+                draft_cfg = draft_cfg.replace(layer_impl="loop")
+            self.draft_cfg = draft_cfg = draft_cfg.replace(remat=False)
+            self.draft_num_blocks = (draft_num_blocks
+                                     or slots * self.max_blocks_per_slot + 1)
+            self.draft_model = Transformer(draft_cfg)
+        elif draft_cfg is not None or draft_params is not None:
+            raise ValueError("draft model given but spec_k == 0")
+
         with use_mesh(mesh):
             shardings = param_shardings(params, mesh)
             if shardings is not None:
@@ -164,6 +218,16 @@ class InferenceEngine:
             cs = cache_shardings(cache, mesh)
             self.cache = (jax.device_put(cache, cs) if cs is not None
                           else cache)
+            if self.spec_k:
+                dsh = param_shardings(draft_params, mesh)
+                if dsh is not None:
+                    draft_params = jax.device_put(draft_params, dsh)
+                self.draft_params = jax.tree_util.tree_map(jnp.asarray,
+                                                           draft_params)
+                dcache = self._init_draft_cache(cache_dtype)
+                dcs = cache_shardings(dcache, mesh)
+                self.draft_cache = (jax.device_put(dcache, dcs)
+                                    if dcs is not None else dcache)
             self._build_programs()
 
     def _init_cache(self, dtype=None):
@@ -172,6 +236,11 @@ class InferenceEngine:
                                     self.block_size, self.num_blocks,
                                     dtype=dtype)
         return init_cache(self.cfg, self.slots, self.max_len, dtype=dtype)
+
+    def _init_draft_cache(self, dtype=None):
+        return init_paged_cache(self.draft_cfg, self.slots, self.max_len,
+                                self.block_size, self.draft_num_blocks,
+                                dtype=dtype)
 
     # --- compiled programs -------------------------------------------------
 
@@ -217,8 +286,9 @@ class InferenceEngine:
         lengths = cache.lengths + active.astype(jnp.int32)
         return KVCache(k=nk, v=nv, lengths=lengths), toks
 
-    def _paged_prefill_fn(self, params, cache, block_row, tokens, slot,
-                          chunk_start, chunk_len, temperature, top_p, seed):
+    def _paged_prefill_fn(self, model, params, cache, block_row, tokens,
+                          slot, chunk_start, chunk_len, temperature, top_p,
+                          seed):
         """One prefill CHUNK: (1, bucket) tokens at absolute positions
         ``chunk_start + [0, chunk_len)`` written through the slot's block
         ``block_row`` (blocks_per_slot,); pad positions past ``chunk_len``
@@ -226,10 +296,12 @@ class InferenceEngine:
         past the slot's allocation). Returns the updated cache and a token
         sampled from the chunk's last real position — meaningful on the
         FINAL chunk (the host loop discards the rest: intermediate chunks'
-        last logits predict tokens the prompt already contains)."""
+        last logits predict tokens the prompt already contains).
+        ``model`` is bound with functools.partial before jit — the same
+        program body prefills the target and (spec mode) the draft."""
         valid = (jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
                  < chunk_len)
-        logits, (nk, nv) = self.model.apply(
+        logits, (nk, nv) = model.apply(
             {"params": params}, tokens, cache.k, cache.v, chunk_start[None],
             block_tables=block_row[None, :], write_valid=valid,
             method="forward_with_cache")
@@ -257,6 +329,129 @@ class InferenceEngine:
         lengths = cache.lengths + active.astype(jnp.int32)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), toks
 
+    def _draft_k_fn(self, params, cache, block_tables, tokens, offsets,
+                    active, temperature, top_p, seeds, rounds):
+        """All k chained draft micro-steps in ONE compiled program.
+
+        Feeds ``[t_last, d_1 .. d_k]`` at offsets ``offsets + [0, k]``
+        through a ``lax.fori_loop`` (the body — one draft forward — is
+        traced once, so compile time is O(1) in k and the host pays one
+        dispatch for the whole chain). Iteration i writes the fed token's
+        KV through the draft block tables and samples proposal d_{i+1} with
+        its post-filter distribution; a final trailing forward back-fills
+        d_k's KV (sampling discarded) so a FULLY accepted round leaves the
+        draft cache covering every emitted token — without it the next
+        round's offsets would skip d_k's missing entry. (Folding that
+        back-fill into a width-2 first micro-step was tried and measured
+        SLOWER: S > 1 leaves the single-position decode attention path, and
+        the generic chunk path's full-pool gather costs more than the one
+        extra S=1 forward it saves.) Offsets come from the HOST's
+        committed-token count, not cache.lengths: rejected suffixes from
+        earlier rounds are rolled back simply by feeding the correct lower
+        offset, their stale KV masked and overwritten.
+
+        Returns (cache, draft_tokens (B, k) int32, draft_probs (B, k, V)
+        fp32) — consumed by the verify program device-to-device.
+        """
+        k = self.spec_k
+        b = self.slots
+        v = self.draft_cfg.vocab_size
+        toks0 = jnp.zeros((b, k), jnp.int32)
+        probs0 = jnp.zeros((b, k, v), jnp.float32)
+        valid = active[:, None]
+
+        def micro_step(i, cur, ck, cv):
+            logits, (nk, nv) = self.draft_model.apply(
+                {"params": params}, cur[:, None], ck, cv, offsets + i,
+                block_tables=block_tables, write_valid=valid,
+                method="forward_with_cache")
+            return logits[:, 0].astype(jnp.float32), nk, nv
+
+        def body(i, carry):
+            ck, cv, cur, toks, probs = carry
+            last, ck, cv = micro_step(i, cur, ck, cv)
+            keys = jax.vmap(draft_key)(seeds, rounds * (k + 1) + i)
+            nxt, p = jax.vmap(sample_token_with_probs,
+                              in_axes=(0, 0, 0, 0, None))(
+                last, keys, temperature, top_p, self.top_k)
+            toks = jax.lax.dynamic_update_slice_in_dim(
+                toks, nxt[:, None], i, axis=1)
+            probs = jax.lax.dynamic_update_slice_in_dim(
+                probs, p[:, None, :], i, axis=1)
+            return ck, cv, nxt, toks, probs
+
+        ck, cv, cur, toks, probs = jax.lax.fori_loop(
+            0, k, body, (cache.k, cache.v, tokens, toks0, probs0))
+        _, ck, cv = micro_step(jnp.int32(k), cur, ck, cv)  # d_k KV back-fill
+        lengths = jnp.where(active, offsets + k + 1, cache.lengths)
+        return PagedKVCache(k=ck, v=cv, lengths=lengths), toks, probs
+
+    def _verify_fn(self, params, cache, block_tables, tokens, draft_tokens,
+                   draft_probs, offsets, active, temperature, top_p, seeds,
+                   rounds):
+        """Score all k+1 candidate positions in ONE compiled program and
+        accept/resample (sampler.py ``spec_accept``).
+
+        Two implementations of the scoring, selected by
+        ``spec_verify_impl`` (same math, different numerics/perf point):
+
+        - ``"exact"`` (default): k+1 chained S=1 micro-steps in a
+          ``lax.fori_loop`` — the exact forward the decode program runs.
+          Identical op shapes compile to identical GEMM accumulation
+          orders, so the greedy bit-exactness invariant is STRUCTURAL.
+          The host pays one dispatch for the whole verify; eliminating
+          the k+1 decode dispatches is the speculative win on
+          accelerators (the target FLOPs themselves are not reduced).
+        - ``"chunk"``: one (B, k+1) forward through
+          ``verify_with_cache`` — additionally batches the verify FLOPs
+          into one GEMM pass, the extra win visible even where dispatch
+          is free (the CPU bench). But bf16 GEMMs accumulate in a
+          shape-dependent order, and a one-ulp logit near-tie is enough
+          to flip an argmax between the S=1 and S=k+1 programs (observed
+          once in ~10k greedy positions on the CPU bench: top-2 logits
+          2.65625 vs 2.640625, the two programs picking opposite
+          winners) — greedy equivalence is exact argmax matching on the
+          CHUNK's logits, bitwise-equal to the non-speculative stream
+          only up to such ties.
+
+        Commits the accepted prefix by setting lengths to ``offsets +
+        accepted + 1``; the rejected suffix's KV is stale pool content
+        past that length — masked, then overwritten next round. Inactive
+        slots write into the null block and keep their lengths."""
+        k = self.spec_k
+        b = self.slots
+        v = self.cfg.vocab_size
+        seq = jnp.concatenate([tokens[:, None], draft_tokens], axis=1)
+        valid = active[:, None]
+        if self.spec_verify_impl == "chunk":
+            chunk, (nk, nv) = self.model.apply(
+                {"params": params}, seq, cache.k, cache.v, offsets,
+                block_tables=block_tables, write_valid=valid,
+                method="verify_with_cache")
+            logits = chunk.astype(jnp.float32)
+        else:
+            logits0 = jnp.zeros((b, k + 1, v), jnp.float32)
+
+            def body(i, carry):
+                ck, cv, logits = carry
+                cur = jax.lax.dynamic_slice_in_dim(seq, i, 1, axis=1)
+                step, (sk, sv) = self.model.apply(
+                    {"params": params}, cur, ck, cv, offsets + i,
+                    block_tables=block_tables, write_valid=valid,
+                    method="forward_with_cache")
+                logits = jax.lax.dynamic_update_slice_in_dim(
+                    logits, step.astype(jnp.float32), i, axis=1)
+                return sk, sv, logits
+
+            nk, nv, logits = jax.lax.fori_loop(
+                0, k + 1, body, (cache.k, cache.v, logits0))
+        keys = jax.vmap(verify_key)(seeds, rounds)
+        out, acc = jax.vmap(spec_accept, in_axes=(0, 0, 0, 0, 0, 0, None))(
+            draft_tokens, draft_probs, logits, keys,
+            temperature, top_p, self.top_k)
+        lengths = jnp.where(active, offsets + acc + 1, cache.lengths)
+        return PagedKVCache(k=nk, v=nv, lengths=lengths), out, acc
+
     def _build_programs(self):
         p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
         scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
@@ -277,9 +472,37 @@ class InferenceEngine:
             for b in self.prefill_buckets:
                 tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
                 self._prefill[b] = jax.jit(
-                    self._paged_prefill_fn, donate_argnums=(1,)).lower(
+                    functools.partial(self._paged_prefill_fn, self.model),
+                    donate_argnums=(1,)).lower(
                     p_abs, c_abs, row_abs, tok_abs, scalar_i, scalar_i,
                     scalar_i, scalar_f, scalar_f, scalar_i).compile()
+            if self.spec_k:
+                dp_abs = _abstract(self.draft_params)
+                dc_abs = _abstract(self.draft_cache)
+                dtoks_abs = jax.ShapeDtypeStruct(
+                    (self.slots, self.spec_k), jnp.int32)
+                dprobs_abs = jax.ShapeDtypeStruct(
+                    (self.slots, self.spec_k, self.cfg.vocab_size),
+                    jnp.float32)
+                self._draft_k = jax.jit(
+                    self._draft_k_fn, donate_argnums=(1,)).lower(
+                    dp_abs, dc_abs, tables_abs, slots_i, slots_i, slots_b,
+                    slots_f, slots_f, slots_i, slots_i).compile()
+                self._verify = jax.jit(
+                    self._verify_fn, donate_argnums=(1,)).lower(
+                    p_abs, c_abs, tables_abs, slots_i, dtoks_abs,
+                    dprobs_abs, slots_i, slots_b, slots_f, slots_f,
+                    slots_i, slots_i).compile()
+                self._draft_prefill = {}
+                for b in self.prefill_buckets:
+                    tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
+                    self._draft_prefill[b] = jax.jit(
+                        functools.partial(self._paged_prefill_fn,
+                                          self.draft_model),
+                        donate_argnums=(1,)).lower(
+                        dp_abs, dc_abs, row_abs, tok_abs, scalar_i,
+                        scalar_i, scalar_i, scalar_f, scalar_f,
+                        scalar_i).compile()
             return
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,)).lower(
             p_abs, c_abs, slots_i, slots_b, slots_f, slots_f, slots_i,
@@ -293,8 +516,38 @@ class InferenceEngine:
 
     # --- host API ----------------------------------------------------------
 
+    def _stream_chunks(self, draft: bool, row, ids, slot, temperature,
+                       top_p, seed, stop_check, on_chunk):
+        """Stream ``ids`` through the paged prefill bucket programs of the
+        target (or, spec mode, the draft) model; returns the final chunk's
+        sampled token, or None if ``stop_check`` fired between chunks."""
+        n = ids.size
+        chunk = self.prefill_buckets[-1]
+        start, tok = 0, None
+        while start < n:
+            m = min(chunk, n - start)
+            bucket = next(b for b in self.prefill_buckets if b >= m)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :m] = ids[start:start + m]
+            args = (row, padded, np.int32(slot), np.int32(start),
+                    np.int32(m), np.float32(temperature), np.float32(top_p),
+                    np.int32(seed))
+            if draft:
+                self.draft_cache, tok = self._draft_prefill[bucket](
+                    self.draft_params, self.draft_cache, *args)
+            else:
+                self.cache, tok = self._prefill[bucket](
+                    self.params, self.cache, *args)
+            start += m
+            if on_chunk is not None:
+                on_chunk()
+            if start < n and stop_check is not None and stop_check():
+                return None  # interrupted between chunks; request unserved
+        return tok
+
     def prefill(self, slot: int, token_ids, block_row=None,
-                temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
+                draft_block_row=None, temperature: float = 0.0,
+                top_p: float = 1.0, seed: int = 0,
                 stop_check: Optional[Callable[[], bool]] = None,
                 on_chunk: Optional[Callable[[], None]] = None
                 ) -> Optional[int]:
@@ -309,6 +562,14 @@ class InferenceEngine:
         if it returns True the prefill stops cleanly AFTER the current chunk
         and returns None (caller frees the blocks and reports the request
         unserved: the drain-lifecycle contract for mid-prompt signals).
+
+        Spec mode additionally prefills the DRAFT cache through
+        ``draft_block_row`` (its own pool's allocation) after the target
+        phase — same chunking, same ``stop_check`` at every chunk boundary
+        including the phase boundary, so a mid-prompt drain still frees
+        BOTH pools and reports the request unserved. The draft phase's
+        sampled token is discarded (the target's first token is the one
+        emitted; the draft proposes only from round 1 on).
         """
         ids = np.asarray(token_ids, np.int32).reshape(-1)
         n = ids.size
@@ -331,22 +592,24 @@ class InferenceEngine:
         if row.shape[0] != self.max_blocks_per_slot:
             raise ValueError(f"block_row has {row.shape[0]} entries, "
                              f"expected {self.max_blocks_per_slot}")
-        chunk = self.prefill_buckets[-1]
-        start, tok = 0, None
-        while start < n:
-            m = min(chunk, n - start)
-            bucket = next(b for b in self.prefill_buckets if b >= m)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :m] = ids[start:start + m]
-            self.cache, tok = self._prefill[bucket](
-                self.params, self.cache, row, padded, np.int32(slot),
-                np.int32(start), np.int32(m), np.float32(temperature),
-                np.float32(top_p), np.int32(seed))
-            start += m
-            if on_chunk is not None:
-                on_chunk()
-            if start < n and stop_check is not None and stop_check():
-                return None  # interrupted between chunks; request unserved
+        if self.spec_k and draft_block_row is None:
+            raise ValueError("spec-mode prefill requires draft_block_row")
+        tok = self._stream_chunks(False, row, ids, slot, temperature, top_p,
+                                  seed, stop_check, on_chunk)
+        if tok is None:
+            return None
+        if self.spec_k:
+            if stop_check is not None and stop_check():
+                return None  # drain at the target/draft phase boundary
+            drow = np.asarray(draft_block_row, np.int32).reshape(-1)
+            if drow.shape[0] != self.max_blocks_per_slot:
+                raise ValueError(
+                    f"draft_block_row has {drow.shape[0]} entries, "
+                    f"expected {self.max_blocks_per_slot}")
+            if self._stream_chunks(True, drow, ids, slot, temperature,
+                                   top_p, seed, stop_check,
+                                   on_chunk) is None:
+                return None
         return int(tok)
 
     def decode_step(self, tokens, active, temperature, top_p, seeds, steps,
@@ -373,6 +636,44 @@ class InferenceEngine:
             np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
         return np.asarray(toks)
 
+    def spec_round(self, tokens, lengths, active, temperature, top_p, seeds,
+                   rounds, block_tables=None, draft_block_tables=None):
+        """One speculative round over all slots: k draft proposals then one
+        verify pass — two dispatches for up to k+1 emitted tokens.
+
+        ``lengths`` (slots,) is each slot's COMMITTED KV count, i.e.
+        ``prompt_len + emitted - 1`` (the last emitted token's KV is not yet
+        written; the round writes it at ``lengths[s]`` first) — the host
+        derives it from its own token bookkeeping, which is what makes
+        rejected-suffix rollback free: stale device KV past the committed
+        prefix is simply re-addressed. ``tokens`` is each slot's last
+        emitted token, ``rounds`` its per-request round counter (PRNG
+        stream index). Returns ``(out_tokens (slots, k+1), accepted
+        (slots,))`` host arrays: slot s emitted ``accepted[s] + 1`` tokens,
+        ``out_tokens[s, :accepted[s] + 1]`` (accepted draft prefix plus the
+        verify pass's bonus/resampled token).
+        """
+        if not self.spec_k:
+            raise ValueError("engine built without a draft model "
+                             "(spec_k == 0)")
+        if block_tables is None or draft_block_tables is None:
+            raise ValueError("spec_round requires both pools' block tables")
+        toks = np.asarray(tokens, np.int32)
+        lens = np.asarray(lengths, np.int32)
+        act = np.asarray(active, bool)
+        temp = np.asarray(temperature, np.float32)
+        tp = np.asarray(top_p, np.float32)
+        sd = np.asarray(seeds, np.int32)
+        rd = np.asarray(rounds, np.int32)
+        self.draft_cache, d_toks, d_probs = self._draft_k(
+            self.draft_params, self.draft_cache,
+            np.asarray(draft_block_tables, np.int32), toks, lens, act, temp,
+            tp, sd, rd)
+        self.cache, out, acc = self._verify(
+            self.params, self.cache, np.asarray(block_tables, np.int32),
+            toks, d_toks, d_probs, lens, act, temp, tp, sd, rd)
+        return np.asarray(out), np.asarray(acc)
+
     def reset(self) -> None:
         """Zero all slot lengths (the buffers' stale contents are masked)."""
         with use_mesh(self.mesh):
@@ -380,6 +681,12 @@ class InferenceEngine:
             cs = cache_shardings(cache, self.mesh)
             self.cache = (jax.device_put(cache, cs) if cs is not None
                           else cache)
+            if self.spec_k:
+                dcache = self._init_draft_cache(
+                    dtype=self.draft_cache.k[0].dtype)
+                dcs = cache_shardings(dcache, self.mesh)
+                self.draft_cache = (jax.device_put(dcache, dcs)
+                                    if dcs is not None else dcache)
 
     # --- construction from a training checkpoint ---------------------------
 
@@ -392,45 +699,65 @@ class InferenceEngine:
         ``cfg`` must be the architecture the checkpoint was trained with
         (scan/loop form included — the abstract TrainState has to match the
         saved tree); the restore itself is the trainer's own cross-topology
-        path, so a checkpoint written on any mesh loads onto this one. The
-        optimizer state is restored alongside (the Composite item layout is
-        fixed) and dropped.
+        path, so a checkpoint written on any mesh loads onto this one
+        (:func:`restore_params`). ``engine_kwargs`` passes through to the
+        constructor — including ``draft_cfg``/``draft_params``/``spec_k``
+        for speculative decoding (serve.py restores the draft checkpoint
+        through the same :func:`restore_params` path first).
         """
-        from ..checkpoint.manager import CheckpointManager
-        from ..parallel.mesh import make_mesh
-        from ..parallel.sharding import param_pspecs
-        from ..training.state import TrainState
-        from ..training.step import make_optimizer
-        from jax.sharding import NamedSharding
-
-        model = Transformer(cfg)
-        # only the opt_state TREE matters (restored then dropped); any
-        # schedule yields the same optax.adamw structure
-        optimizer = make_optimizer(1e-4, 1)
-        dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
-
-        def init_fn(key):
-            params = model.init(key, dummy)["params"]
-            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=optimizer.init(params))
-
-        # Orbax needs target shardings; without a serving mesh, restore onto
-        # a trivial single-device mesh (replicated specs, device 0).
-        restore_mesh = mesh or make_mesh(dp=1, devices=jax.devices()[:1])
-        with use_mesh(restore_mesh):
-            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-            specs = param_pspecs(abstract)
-            abstract = jax.tree_util.tree_map(
-                lambda a, s: jax.ShapeDtypeStruct(
-                    a.shape, a.dtype,
-                    sharding=NamedSharding(restore_mesh, s)),
-                abstract, specs,
-                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
-            mngr = CheckpointManager(checkpoint_path, job_id,
-                                     enable_async=False)
-            state, _data, restored_step = mngr.restore(abstract, step=step)
-            mngr.close()
+        params, restored_step = restore_params(checkpoint_path, job_id, cfg,
+                                               step=step, mesh=mesh)
         logger.info("Model loaded from checkpoint")  # ref: train.py:58
-        engine = cls(cfg, state.params, mesh=mesh, **engine_kwargs)
+        engine = cls(cfg, params, mesh=mesh, **engine_kwargs)
         engine.restored_step = restored_step
         return engine
+
+
+def restore_params(checkpoint_path: str, job_id: str, cfg: TransformerConfig,
+                   *, step: Optional[int] = None, mesh=None):
+    """Restore ONLY the params collection of a training checkpoint.
+
+    The abstract TrainState is rebuilt exactly as the trainer builds it
+    (the saved tree must match, optimizer state included — restored
+    alongside and dropped), so a checkpoint written on any training
+    topology loads onto the serving mesh. Factored out of
+    :meth:`InferenceEngine.from_checkpoint` so the speculative-decoding
+    path can load a DRAFT model's checkpoint — any preset, its own
+    training run — through the identical cross-topology machinery.
+    Returns ``(params, restored_step)``.
+    """
+    from ..checkpoint.manager import CheckpointManager
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import param_pspecs
+    from ..training.state import TrainState
+    from ..training.step import make_optimizer
+    from jax.sharding import NamedSharding
+
+    model = Transformer(cfg)
+    # only the opt_state TREE matters (restored then dropped); any
+    # schedule yields the same optax.adamw structure
+    optimizer = make_optimizer(1e-4, 1)
+    dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
+
+    def init_fn(key):
+        params = model.init(key, dummy)["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+
+    # Orbax needs target shardings; without a serving mesh, restore onto
+    # a trivial single-device mesh (replicated specs, device 0).
+    restore_mesh = mesh or make_mesh(dp=1, devices=jax.devices()[:1])
+    with use_mesh(restore_mesh):
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        specs = param_pspecs(abstract)
+        abstract = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(
+                a.shape, a.dtype,
+                sharding=NamedSharding(restore_mesh, s)),
+            abstract, specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        mngr = CheckpointManager(checkpoint_path, job_id,
+                                 enable_async=False)
+        state, _data, restored_step = mngr.restore(abstract, step=step)
+        mngr.close()
+    return state.params, restored_step

@@ -134,14 +134,22 @@ def write_paged_kv(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
     (B*S)-row scatter per call, never the whole cache. Positions with
     ``valid`` (B, S) False (bucket padding past the prompt, inactive decode
     slots) are redirected into null block 0, so a static-shape write can
-    never land in another request's blocks. Valid positions map to distinct
-    (block, offset) pairs (the allocator hands each slot disjoint blocks),
-    so the scatter is collision-free where it matters."""
+    never land in another request's blocks. Positions past the table's reach
+    (start + S can exceed blocks_per_slot * bs in a speculative verify round
+    whose draft overruns a nearly-full slot) also divert to the null block —
+    clipping them into the last table column would wrap the write onto the
+    slot's OWN committed KV at ``pos % bs`` and silently corrupt it. Valid
+    in-range positions map to distinct (block, offset) pairs (the allocator
+    hands each slot disjoint blocks), so the scatter is collision-free where
+    it matters."""
     bs = pool.shape[2]
     b, k, s, d = new.shape
     pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]   # (B, S)
-    idx = jnp.clip(pos // bs, 0, block_tables.shape[1] - 1)
-    blk = jnp.where(valid, jnp.take_along_axis(block_tables, idx, axis=1), 0)
+    raw = pos // bs
+    idx = jnp.clip(raw, 0, block_tables.shape[1] - 1)
+    in_table = raw < block_tables.shape[1]
+    blk = jnp.where(valid & in_table,
+                    jnp.take_along_axis(block_tables, idx, axis=1), 0)
     off = pos % bs
     upd = jnp.transpose(new, (0, 2, 1, 3)).reshape(b * s, k, d)
     return pool.at[blk.reshape(-1), :, off.reshape(-1), :].set(upd)

@@ -9,6 +9,19 @@ reproducible across engine restarts exactly like greedy ones
 
 ``temperature <= 0`` selects greedy argmax (the scheduler's default), so one
 decode program serves mixed greedy/sampled slots without recompilation.
+
+Speculative decoding (engine.py spec mode) adds two kernels on the same
+filtered distributions:
+
+- :func:`sample_token_with_probs` — the draft model's proposal step; it
+  returns the token AND the exact post-filter distribution q it was drawn
+  from (greedy: a one-hot), because the verify-side acceptance test needs
+  q(d), not the raw logits.
+- :func:`spec_accept` — the Leviathan/Chen accept/resample rule, vectorized
+  over the k+1 verify positions. With one-hot greedy distributions the
+  acceptance test ``u * q(d) < p(d)`` degenerates to exact argmax matching
+  and the resample to the target argmax, so the single kernel serves both
+  modes and greedy outputs stay BIT-identical to the non-speculative path.
 """
 
 import jax
@@ -58,3 +71,110 @@ def sample_token(logits: jnp.ndarray, key: jax.Array,
 def slot_key(seed: jnp.ndarray, step: jnp.ndarray) -> jax.Array:
     """Per-slot, per-step PRNG key: request seed folded by decode step."""
     return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+
+def draft_key(seed: jnp.ndarray, step: jnp.ndarray) -> jax.Array:
+    """Draft-proposal PRNG stream, disjoint from :func:`slot_key`'s so the
+    draft model's sampling never aliases the target's (``step`` here is the
+    flat draft micro-step counter ``round * (k + 1) + i``)."""
+    return jax.random.fold_in(slot_key(seed, step), 0x5D)
+
+
+def verify_key(seed: jnp.ndarray, round_: jnp.ndarray) -> jax.Array:
+    """Accept/resample PRNG stream for one verify round, disjoint from both
+    :func:`slot_key` and :func:`draft_key`."""
+    return jax.random.fold_in(slot_key(seed, round_), 0x7E)
+
+
+def sample_token_with_probs(logits: jnp.ndarray, key: jax.Array,
+                            temperature: jnp.ndarray, top_p: jnp.ndarray,
+                            top_k: int = 0):
+    """Like :func:`sample_token` but also returns the post-filter
+    distribution the token was drawn from: softmax of the temperature-scaled,
+    top-k/top-p-filtered logits for sampled slots, an exact one-hot at the
+    argmax for greedy slots. The speculative accept test is stated on these
+    distributions — using raw-softmax q with filtered sampling would bias
+    the acceptance ratio."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        scaled = _top_k_filter(scaled, top_k)
+    scaled = _top_p_filter(scaled, top_p)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    tok = jnp.where(temperature > 0.0, sampled, greedy)
+    probs = jnp.where(temperature > 0.0, jax.nn.softmax(scaled),
+                      jax.nn.one_hot(greedy, v, dtype=jnp.float32))
+    return tok, probs
+
+
+def _filtered_probs(logits: jnp.ndarray, temperature: jnp.ndarray,
+                    top_p: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Row-wise post-filter target distributions for (S, V) logits; greedy
+    rows are exact one-hots (see :func:`sample_token_with_probs`)."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        scaled = jax.vmap(_top_k_filter, in_axes=(0, None))(scaled, top_k)
+    scaled = jax.vmap(_top_p_filter, in_axes=(0, None))(scaled, top_p)
+    return jnp.where(temperature > 0.0, jax.nn.softmax(scaled, axis=-1),
+                     jax.nn.one_hot(greedy, v, dtype=jnp.float32))
+
+
+def spec_accept(draft_tokens: jnp.ndarray, draft_probs: jnp.ndarray,
+                target_logits: jnp.ndarray, key: jax.Array,
+                temperature: jnp.ndarray, top_p: jnp.ndarray,
+                top_k: int = 0):
+    """Speculative accept/resample for ONE slot (the engine vmaps it).
+
+    draft_tokens: (k,) int32 proposals d_1..d_k.
+    draft_probs:  (k, V) fp32 — q_i, the distribution d_i was drawn from.
+    target_logits: (k+1, V) fp32 — verify-pass logits; row i scores the
+                  position AFTER d_i's prefix (row 0 = after the committed
+                  context), so row i's filtered distribution p_i is the
+                  target's next-token law at d_i's position and row k's is
+                  the bonus position past a fully-accepted draft.
+
+    Rule (Leviathan et al. 2023; Chen et al. 2023): accept d_i while
+    ``u_i < p_i(d_i) / q_i(d_i)`` holds for the leading run (stated below
+    multiplicatively as ``u_i * q_i(d_i) < p_i(d_i)`` — no divide-by-zero);
+    at the first rejection emit one token from the residual
+    ``norm(max(p_a - q_a, 0))``; on full acceptance emit the bonus token
+    from p_k. The emitted prefix is distributed EXACTLY as k+1 sequential
+    target samples. Greedy rows make both q and p one-hots: the test
+    becomes exact argmax matching (u < 1 always, uniform is [0, 1)) and the
+    residual collapses to the target argmax — selected via a ``where`` so
+    greedy never consumes gumbel noise and stays bit-exact.
+
+    Returns ``(out_tokens, accepted)``: out_tokens (k+1,) int32 holds the
+    a = accepted accepted drafts then the resampled/bonus token at index a
+    (tail entries past a are zeros the caller ignores).
+    """
+    k, v = draft_probs.shape
+    greedy_toks = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+    p = _filtered_probs(target_logits, temperature, top_p, top_k)  # (k+1, V)
+    q_d = jnp.take_along_axis(draft_probs, draft_tokens[:, None], 1)[:, 0]
+    p_d = jnp.take_along_axis(p[:k], draft_tokens[:, None], 1)[:, 0]
+    u = jax.random.uniform(jax.random.fold_in(key, 0), (k,))
+    accept = u * q_d < p_d
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))  # leading-run length
+    # residual at the first rejected position (q past row k is zero, so a
+    # full accept resolves to the bonus distribution p_k itself)
+    q_pad = jnp.concatenate(
+        [draft_probs, jnp.zeros((1, v), draft_probs.dtype)], axis=0)
+    p_a, q_a = jnp.take(p, a, axis=0), jnp.take(q_pad, a, axis=0)
+    resid = jnp.maximum(p_a - q_a, 0.0)
+    # resid sums to zero only through numerics (p==q exactly); fall back to
+    # p_a so the categorical below stays well-defined
+    resid = jnp.where(resid.sum() > 0.0, resid, p_a)
+    resampled = jax.random.categorical(
+        jax.random.fold_in(key, 1),
+        jnp.log(jnp.maximum(resid, 1e-38))).astype(jnp.int32)
+    bonus = jnp.where(temperature > 0.0, resampled,
+                      jnp.take(greedy_toks, a))
+    idx = jnp.arange(k + 1, dtype=jnp.int32)
+    d_pad = jnp.concatenate(
+        [draft_tokens, jnp.zeros((1,), jnp.int32)], axis=0)
+    out = jnp.where(idx < a, d_pad, 0).at[a].set(bonus)
+    return out, a

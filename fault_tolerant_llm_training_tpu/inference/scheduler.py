@@ -17,6 +17,16 @@ for the next admission. A request whose blocks aren't available yet simply
 waits at the head of the queue (FIFO, no starvation) — exhaustion queues,
 it never crashes.
 
+When the engine was built with a draft model (``spec_k > 0``) the
+scheduler runs SPECULATIVE rounds instead of single-token decode
+iterations: each round emits 1..k+1 tokens per slot (engine.py
+``spec_round``). The draft model has its own block pool, so the scheduler
+owns a SECOND :class:`BlockAllocator` and block table; admission is gated
+by the COMBINED draft+target footprint (both pools must cover the
+request, or it waits at the head of the queue), and eviction/drain frees
+both pools together. Acceptance statistics are exported per round
+(``ftl_spec_*`` metrics) and per request (Completion spec fields).
+
 The scheduler is also the drain point for the fault-tolerant serving
 lifecycle: ``stop_admission()`` (serve.py calls it when a SIGUSR1/SIGTERM
 flag fires) freezes the queue while active slots run to completion, so
@@ -34,7 +44,11 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..obs.registry import MetricRegistry, default_registry
+from ..obs.registry import (
+    SPEC_TOKEN_BUCKETS,
+    MetricRegistry,
+    default_registry,
+)
 
 
 class BlockAllocator:
@@ -101,6 +115,15 @@ class Completion:
     submitted_at: float
     first_token_at: float
     finished_at: float
+    # Speculative-decoding accounting (zero in non-spec mode): draft tokens
+    # proposed for this request, proposals the verify pass accepted, and
+    # tokens EMITTED-NOT-PROPOSED — the verify pass's bonus/corrected
+    # tokens, i.e. output the draft never suggested (the drain audit logs
+    # these per request so an operator can see how much of a stream the
+    # draft actually produced).
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_emitted_not_proposed: int = 0
 
     @property
     def ttft_seconds(self) -> float:
@@ -126,6 +149,10 @@ class _Slot:
         self.steps = 1  # decode-step counter; prefill consumed step 0
         self.submitted_at = submitted_at
         self.first_token_at = now
+        # spec-mode per-request accounting (see Completion)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_corrected = 0
 
 
 class Scheduler:
@@ -157,6 +184,17 @@ class Scheduler:
             self.block_tables = np.zeros(
                 (engine.slots, engine.max_blocks_per_slot), np.int32)
             self._slot_blocks: Dict[int, List[int]] = {}
+        # Speculative mode: the draft model's pool gets its own allocator
+        # and block table; admission requires BOTH footprints (below).
+        self.spec_k = int(getattr(engine, "spec_k", 0) or 0)
+        if self.spec_k:
+            self.draft_allocator = BlockAllocator(engine.draft_num_blocks)
+            self.draft_block_tables = np.zeros(
+                (engine.slots, engine.max_blocks_per_slot), np.int32)
+            self._slot_draft_blocks: Dict[int, List[int]] = {}
+            self.spec_rounds = 0
+            self.spec_draft_tokens = 0
+            self.spec_accepted_tokens = 0
         # /metrics surface (obs/registry.py): serve.py --metrics-port scrapes
         # these live while the batching loop runs.
         r = registry or default_registry()
@@ -187,6 +225,20 @@ class Scheduler:
         self._m_chunks = r.counter(
             "ftl_serve_prefill_chunks_total",
             "Prefill chunks executed (chunked long-prompt prefill)")
+        self._m_spec_draft = r.counter(
+            "ftl_spec_draft_tokens_total",
+            "Draft-model tokens proposed (speculative decoding)")
+        self._m_spec_accepted = r.counter(
+            "ftl_spec_accepted_tokens_total",
+            "Draft proposals accepted by the target verify pass")
+        self._m_spec_rate = r.gauge(
+            "ftl_spec_acceptance_rate",
+            "Running accepted/proposed draft-token ratio (0-1)")
+        self._m_spec_round_tokens = r.histogram(
+            "ftl_spec_tokens_per_round",
+            "Tokens banked per verify round (accepted prefix + bonus, "
+            "after EOS/budget truncation)",
+            buckets=SPEC_TOKEN_BUCKETS)
         if self.kv_layout == "paged":
             self._m_blocks_free.set(self.allocator.free_count)
 
@@ -208,6 +260,12 @@ class Scheduler:
                 f"request {request.id}: needs {self._blocks_needed(request)} "
                 f"KV blocks but the pool only has "
                 f"{self.allocator.capacity} usable blocks")
+        if (self.spec_k and self._blocks_needed(request)
+                > self.draft_allocator.capacity):
+            raise ValueError(
+                f"request {request.id}: needs {self._blocks_needed(request)} "
+                f"DRAFT KV blocks but the draft pool only has "
+                f"{self.draft_allocator.capacity} usable blocks")
         self.queue.append((request, self.clock()))
 
     def stop_admission(self) -> None:
@@ -229,12 +287,20 @@ class Scheduler:
             if blocks:
                 self.allocator.free(blocks)
                 self.block_tables[slot] = 0
+        if self.spec_k:
+            dblocks = self._slot_draft_blocks.pop(slot, None)
+            if dblocks:
+                self.draft_allocator.free(dblocks)
+                self.draft_block_tables[slot] = 0
         c = Completion(request_id=st.request.id,
                        prompt_len=len(st.request.prompt),
                        tokens=list(st.tokens), reason=reason,
                        submitted_at=st.submitted_at,
                        first_token_at=st.first_token_at,
-                       finished_at=self.clock())
+                       finished_at=self.clock(),
+                       spec_proposed=st.spec_proposed,
+                       spec_accepted=st.spec_accepted,
+                       spec_emitted_not_proposed=st.spec_corrected)
         self.completed.append(c)
         done.append(c)
         self._m_ttft.observe(c.ttft_seconds)
@@ -251,36 +317,60 @@ class Scheduler:
         free = [s for s in range(self.engine.slots) if s not in self.active]
         while free and self.queue:
             req, submitted_at = self.queue[0]
-            blocks = None
+            blocks, dblocks = None, None
             if self.kv_layout == "paged":
                 # admission is by free-BLOCK count, not free-slot count:
                 # the head of the queue waits (FIFO, no starvation) until
-                # eviction frees enough blocks for its actual need.
+                # eviction frees enough blocks for its actual need. Spec
+                # mode admits by the COMBINED footprint — both pools must
+                # cover the request, and a partial grab is rolled back so
+                # a draft-pool shortage can't strand target blocks.
                 blocks = self.allocator.alloc(self._blocks_needed(req))
                 if blocks is None:
                     break
+                if self.spec_k:
+                    dblocks = self.draft_allocator.alloc(
+                        self._blocks_needed(req))
+                    if dblocks is None:
+                        self.allocator.free(blocks)
+                        break
             self.queue.popleft()
             slot = free.pop(0)
             if self.kv_layout == "paged":
                 row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
                 row[:len(blocks)] = blocks
                 self.block_tables[slot] = row
+                spec_kw = {}
+                if self.spec_k:
+                    drow = np.zeros((self.engine.max_blocks_per_slot,),
+                                    np.int32)
+                    drow[:len(dblocks)] = dblocks
+                    self.draft_block_tables[slot] = drow
+                    # only spec-mode engines need (or accept) the draft
+                    # row — non-spec engine doubles keep the old signature
+                    spec_kw["draft_block_row"] = drow
                 first = self.engine.prefill(
                     slot, req.prompt, block_row=row,
                     temperature=req.temperature, top_p=req.top_p,
                     seed=req.seed, stop_check=self._drain_requested,
-                    on_chunk=self._count_chunk)
+                    on_chunk=self._count_chunk, **spec_kw)
                 if first is None:
                     # Drain fired mid-prompt: the engine finished the
-                    # current chunk and stopped. Free the blocks, put the
-                    # request back at the head so it is REPORTED unserved,
-                    # and close admission — the drain stays exact.
+                    # current chunk and stopped. Free the blocks (both
+                    # pools in spec mode), put the request back at the head
+                    # so it is REPORTED unserved, and close admission —
+                    # the drain stays exact.
                     self.allocator.free(blocks)
                     self.block_tables[slot] = 0
+                    if self.spec_k:
+                        self.draft_allocator.free(dblocks)
+                        self.draft_block_tables[slot] = 0
                     self.queue.appendleft((req, submitted_at))
                     self.stop_admission()
                     return
                 self._slot_blocks[slot] = blocks
+                if self.spec_k:
+                    self._slot_draft_blocks[slot] = dblocks
             else:
                 first = self.engine.prefill(slot, req.prompt,
                                             temperature=req.temperature,
@@ -324,7 +414,20 @@ class Scheduler:
             seeds[s] = st.request.seed
             steps[s] = st.steps
         t0 = self.clock()
-        if self.kv_layout == "paged":
+        if self.spec_k:
+            # Speculative round: lengths[s] is the slot's committed KV
+            # count (prompt + emitted − 1 positions hold keys; the latest
+            # emitted token is the round's input and is written by the
+            # draft/verify programs themselves). steps doubles as the
+            # round counter that derives the per-round PRNG streams.
+            lengths = np.zeros((slots,), np.int32)
+            for s, st in self.active.items():
+                lengths[s] = len(st.request.prompt) + len(st.tokens) - 1
+            out, acc = self.engine.spec_round(
+                tokens, lengths, active, temperature, top_p, seeds, steps,
+                block_tables=self.block_tables,
+                draft_block_tables=self.draft_block_tables)
+        elif self.kv_layout == "paged":
             next_tokens = self.engine.decode_step(
                 tokens, active, temperature, top_p, seeds, steps,
                 block_tables=self.block_tables)
@@ -338,6 +441,9 @@ class Scheduler:
         if wall > 0:
             self._m_tps.set(self._m_tokens.value / wall)
         self.iterations += 1
+        if self.spec_k:
+            self._bank_spec(out, acc, done)
+            return done
         for s in list(self.active):
             st = self.active[s]
             tok = int(next_tokens[s])
@@ -349,6 +455,52 @@ class Scheduler:
             elif len(st.tokens) >= st.request.max_new_tokens:
                 self._finish(s, "length", done)
         return done
+
+    def _bank_spec(self, out: np.ndarray, acc: np.ndarray,
+                   done: List[Completion]) -> None:
+        """Bank one verify round's output: the accepted draft prefix plus
+        the bonus/corrected token at position acc, truncated by EOS and by
+        the request's max_new_tokens budget (truncation discards tokens the
+        non-spec path would never have produced, keeping the emitted stream
+        identical to sequential decoding)."""
+        self.spec_rounds += 1
+        n_active = len(self.active)
+        self.spec_draft_tokens += self.spec_k * n_active
+        self._m_spec_draft.inc(self.spec_k * n_active)
+        round_accepted = 0
+        for s in list(self.active):
+            st = self.active[s]
+            a = int(acc[s])
+            st.steps += 1
+            st.spec_proposed += self.spec_k
+            st.spec_accepted += a
+            round_accepted += a
+            banked = 0
+            finished = None
+            for i in range(a + 1):
+                tok = int(out[s, i])
+                st.tokens.append(tok)
+                banked += 1
+                self._m_tokens.inc()
+                if i == a:
+                    # position acc is the verifier's own token (bonus on
+                    # full accept, correction otherwise) — emitted without
+                    # ever having been proposed by the draft.
+                    st.spec_corrected += 1
+                if self.eos_token_id is not None and tok == self.eos_token_id:
+                    finished = "eos"
+                    break
+                if len(st.tokens) >= st.request.max_new_tokens:
+                    finished = "length"
+                    break
+            self._m_spec_round_tokens.observe(banked)
+            if finished:
+                self._finish(s, finished, done)
+        self.spec_accepted_tokens += round_accepted
+        self._m_spec_accepted.inc(round_accepted)
+        if self.spec_draft_tokens:
+            self._m_spec_rate.set(
+                self.spec_accepted_tokens / self.spec_draft_tokens)
 
     def run(self, stop: Optional[Callable[[], bool]] = None
             ) -> List[Completion]:
@@ -386,4 +538,14 @@ class Scheduler:
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count
             out["kv_block_utilization_peak"] = self.max_block_utilization
+        if self.spec_k:
+            out["spec_k"] = self.spec_k
+            out["spec_rounds"] = self.spec_rounds
+            out["spec_draft_tokens"] = self.spec_draft_tokens
+            out["spec_accepted_tokens"] = self.spec_accepted_tokens
+            out["spec_acceptance_rate"] = (
+                self.spec_accepted_tokens / self.spec_draft_tokens
+                if self.spec_draft_tokens else 0.0)
+            out["draft_kv_blocks_total"] = self.draft_allocator.capacity
+            out["draft_kv_blocks_free"] = self.draft_allocator.free_count
         return out

@@ -38,6 +38,7 @@ from .engine import (
     DEFAULT_COMPILE_CACHE_DIR,
     InferenceEngine,
     enable_compilation_cache,
+    restore_params,
 )
 from .scheduler import Request, Scheduler
 
@@ -92,6 +93,37 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "(default: ~/.cache/fault_tolerant_llm_training_tpu/"
                         "xla-cache; '' disables). Warm engine builds skip "
                         "the AOT prefill/decode compiles")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: draft proposes k tokens per "
+                        "round, one verify pass scores all k+1 positions "
+                        "(0 = off). Requires --draft-checkpoint-path and "
+                        "the paged KV layout; greedy output is bit-exact "
+                        "vs --spec-k 0")
+    p.add_argument("--draft-checkpoint-path", default="",
+                   help="training checkpoint directory of the DRAFT model")
+    p.add_argument("--draft-checkpoint-job-id", default="",
+                   help="job id the draft checkpoint was written under")
+    p.add_argument("--draft-step", type=int, default=None,
+                   help="draft checkpoint step (default: latest)")
+    p.add_argument("--draft-preset", default="tiny",
+                   help="model preset the draft checkpoint was trained "
+                        "with (any models/configs.py preset; must share "
+                        "the target's vocab)")
+    p.add_argument("--draft-layer-impl", default="loop",
+                   choices=("loop", "scan"))
+    p.add_argument("--draft-kv-num-blocks", type=int, default=0,
+                   help="draft KV pool blocks incl. the null block; 0 = "
+                        "full reservation parity. The scheduler admits by "
+                        "the COMBINED footprint across both pools")
+    p.add_argument("--spec-verify-impl", default="exact",
+                   choices=("exact", "chunk"),
+                   help="verify-k scoring: 'exact' micro-steps k+1 S=1 "
+                        "forwards in one program (greedy streams bit-match "
+                        "the non-speculative path by construction); 'chunk' "
+                        "runs one (slots, k+1) forward, batching the verify "
+                        "FLOPs, but bf16 GEMM accumulation is shape-"
+                        "dependent and a one-ulp near-tie can flip an "
+                        "argmax vs the S=1 decode program")
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=1.0)
@@ -139,13 +171,39 @@ def main(argv=None) -> None:
                          layer_impl=args.layer_impl)
         buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
                    if args.prefill_buckets else None)
+        spec_kwargs = {}
+        draft_step_restored = None
+        if args.spec_k:
+            if not (args.draft_checkpoint_path
+                    and args.draft_checkpoint_job_id):
+                raise SystemExit(
+                    "--spec-k requires --draft-checkpoint-path and "
+                    "--draft-checkpoint-job-id")
+            draft_cfg = get_config(args.draft_preset, vocab_size=vocab,
+                                   layer_impl=args.draft_layer_impl)
+            # the draft loads through the SAME cross-topology restore path
+            # as the target — any preset, its own training run
+            draft_params, draft_step_restored = restore_params(
+                args.draft_checkpoint_path, args.draft_checkpoint_job_id,
+                draft_cfg, step=args.draft_step)
+            spec_kwargs = dict(
+                draft_cfg=draft_cfg, draft_params=draft_params,
+                spec_k=args.spec_k,
+                draft_num_blocks=args.draft_kv_num_blocks or None,
+                spec_verify_impl=args.spec_verify_impl)
         engine = InferenceEngine.from_checkpoint(
             args.checkpoint_path, args.checkpoint_job_id, cfg,
             step=args.step, slots=args.slots,
             max_len=args.max_len or None, prefill_buckets=buckets,
             top_k=args.top_k, kv_layout=args.kv_layout,
             kv_block_size=args.kv_block_size,
-            kv_num_blocks=args.kv_num_blocks or None)
+            kv_num_blocks=args.kv_num_blocks or None, **spec_kwargs)
+        if args.spec_k:
+            engine.draft_restored_step = draft_step_restored
+            logger.info(
+                "Speculative decoding | draft=%s step=%s k=%d verify=%s",
+                args.draft_preset, draft_step_restored, args.spec_k,
+                args.spec_verify_impl)
         events.emit_audit(
             logger, AUDIT_SERVE_READY_FMT.format(
                 model=args.model, step=engine.restored_step,
@@ -194,6 +252,16 @@ def main(argv=None) -> None:
                 tokens=len(c.tokens), ttft_ms=c.ttft_seconds * 1e3)
             logger.info("Request %s output: %r", c.request_id,
                         tokenizer.decode(decoded))
+            if args.spec_k:
+                # drain-audit companion: how many of this request's tokens
+                # the verifier emitted that the draft never proposed
+                # (bonus/corrected) — with the proposal/accept counts this
+                # reconciles the emitted stream exactly
+                logger.info(
+                    "Request %s spec: proposed=%d accepted=%d "
+                    "emitted_not_proposed=%d", c.request_id,
+                    c.spec_proposed, c.spec_accepted,
+                    c.spec_emitted_not_proposed)
         if sched.iterations and sched.iterations % args.log_frequency == 0:
             events.emit_audit(
                 logger, AUDIT_SERVE_STEP_FMT.format(
@@ -218,6 +286,12 @@ def main(argv=None) -> None:
                 m["requests_completed"], m["tokens_generated"],
                 m["tokens_per_sec"], m["tokens_per_sec_per_slot"],
                 m["decode_p50_ms"], m["decode_p95_ms"])
+    if args.spec_k:
+        logger.info(
+            "Spec metrics: k=%d | %d rounds | %d drafted | %d accepted | "
+            "acceptance %.3f", m["spec_k"], m["spec_rounds"],
+            m["spec_draft_tokens"], m["spec_accepted_tokens"],
+            m["spec_acceptance_rate"])
     if drained:
         events.emit_audit(
             logger, AUDIT_SERVE_DRAINED_FMT.format(

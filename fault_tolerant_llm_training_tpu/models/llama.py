@@ -520,6 +520,35 @@ class Transformer(nn.Module):
             new_v.append(v_i)
         return self.head(x), (tuple(new_k), tuple(new_v))
 
+    def verify_with_cache(self, tokens, cache_k, cache_v, offsets,
+                          block_tables, write_valid=None):
+        """Speculative-decoding verify entry: score k+1 candidate positions
+        per slot in one forward through the paged caches.
+
+        ``tokens`` (B, k+1) is ``[last_committed, d_1 .. d_k]`` at absolute
+        positions ``offsets[b] + [0, k]``; each row's logits are the
+        target's next-token scores AFTER that prefix — the same masked
+        attention the j-th sequential single-token decode computes
+        (ops/attention.py ``paged_verify_attention`` documents the masking
+        argument), though only equal to it up to shape-dependent bf16 GEMM
+        accumulation order: a one-ulp logit near-tie can flip an argmax
+        between the chunked and single-step programs, which is why the
+        engine's AOT verify program micro-steps S=1 forwards when bitwise
+        greedy equivalence is required (inference/engine.py
+        ``_verify_fn``). Paged layout only — the verify semantics
+        depend on masked writes diverting to the null block so a rejected
+        suffix can be abandoned without device-side rollback. This is a thin
+        named delegation to :meth:`forward_with_cache`: the multi-token path
+        there IS the verify math; the entry pins the contract (and gives the
+        engine's AOT verify program a stable method name).
+        """
+        if block_tables is None:
+            raise ValueError("verify_with_cache requires the paged layout "
+                             "(block_tables)")
+        return self.forward_with_cache(tokens, cache_k, cache_v, offsets,
+                                       block_tables=block_tables,
+                                       write_valid=write_valid)
+
 
 def stack_layer_params(params: dict, n_layers: int) -> dict:
     """Convert a loop-form param tree (``layers_{i}/...``) to the scan form

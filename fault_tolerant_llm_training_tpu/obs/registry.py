@@ -23,6 +23,12 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 DURATION_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                     5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
+# Integer-count buckets for the speculative-decoding tokens-per-verify-round
+# histogram (scheduler.py ftl_spec_tokens_per_round): a round emits between
+# 1 (first proposal rejected) and spec_k + 1 (full accept + bonus) tokens,
+# and spec_k rarely exceeds 8 — 1..16 covers it with exact per-count bins.
+SPEC_TOKEN_BUCKETS = tuple(float(i) for i in range(1, 17))
+
 _LabelKey = Tuple[Tuple[str, str], ...]
 
 

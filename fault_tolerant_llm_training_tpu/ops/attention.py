@@ -133,6 +133,34 @@ def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                             gather_kv_blocks(v_pool, block_tables), offsets)
 
 
+def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                           offsets: jnp.ndarray) -> jnp.ndarray:
+    """Length-k masked verify attention for speculative decoding.
+
+    The verify pass scores S = k+1 candidate positions per slot in ONE
+    forward: queries sit at ``offsets[b] + [0, k]`` (row 0 re-scores the
+    committed last token's position, rows 1..k the draft proposals), and the
+    per-row causal mask of :func:`cached_attention` — ``k_pos <= offsets[b]
+    + j`` — is exactly the verify mask: row j attends the committed prefix
+    plus proposals 1..j and nothing later. The draft rows' keys/values are
+    written through the block tables BEFORE this runs (models/llama.py), so
+    row j attends the same positions j sequential single-token decode steps
+    would have — the verify logits ARE the non-speculative logits up to
+    shape-dependent GEMM accumulation order (the surrounding projections,
+    not this mask, are where a one-ulp bf16 near-tie can diverge; the
+    engine's verify program micro-steps S=1 forwards when it needs bitwise
+    greedy equality — see inference/engine.py ``_verify_fn``).
+    Rejected-suffix positions need no device-side rollback: their stale
+    pool entries sit past the slot's committed length, the mask zeroes them
+    (``exp(finfo.min) == 0`` exactly), and the next round overwrites them.
+
+    Shapes/semantics otherwise match :func:`paged_cached_attention`, whose
+    gather path it reuses unchanged.
+    """
+    return paged_cached_attention(q, k_pool, v_pool, block_tables, offsets)
+
+
 def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         impl: str = "auto", causal: bool = True) -> jnp.ndarray:
     """Dispatch to the requested attention implementation."""

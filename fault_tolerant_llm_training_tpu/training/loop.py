@@ -47,6 +47,7 @@ from ..parallel.mesh import make_mesh, use_mesh
 from ..parallel.sharding import batch_pspec, param_pspecs
 from ..training.state import TrainState
 from ..training.step import make_eval_step, make_optimizer, make_train_step
+from ..utils.compile_cache import enable_compilation_cache
 from ..utils.config import JOBID, TrainConfig
 from ..utils.dtypes import PRECISION_STR_TO_DTYPE
 from ..utils.grad_clip import NonFiniteGradientError
@@ -360,12 +361,30 @@ class Trainer:
         # AOT-compile now, inside the signal-deferred setup window: a
         # preemption signal interrupting XLA compilation can wedge native
         # code, and compilation is the longest uninterruptible stretch
-        # (~35 s model build in the reference, SURVEY.md §3.2).
+        # (~35 s model build in the reference, SURVEY.md §3.2). With
+        # --compile-cache-dir a warm restart replaces the compile with a
+        # disk read; the timed "compile" flight-recorder event is how
+        # goodput reports distinguish cold from warm builds.
+        cache_on = False
+        if cfg.compile_cache_dir:
+            cache_on = enable_compilation_cache(cfg.compile_cache_dir)
+            if cache_on:
+                logger.info(f"Compilation cache | {cfg.compile_cache_dir}")
         batch_struct = jax.ShapeDtypeStruct(
             (cfg.batch_size, cfg.sequence_length), jnp.int32,
             sharding=self.batch_sharding)
+        t_compile = time.perf_counter()
         self._compiled_step = self._jit_step.lower(
             self.abstract_state, batch_struct, batch_struct).compile()
+        compile_secs = time.perf_counter() - t_compile
+        # emitted from run(), AFTER the start/resume audit: the flight-
+        # recorder trail contract is that a job's first event is
+        # start/resume (tests/test_obs.py, goodput stitcher)
+        self._compile_event = dict(step=self.training_step,
+                                   dur=compile_secs,
+                                   cache=("on" if cache_on else "off"))
+        logger.info(f"Train step compiled in {compile_secs:.2f}s "
+                    f"(cache {'on' if cache_on else 'off'})")
         self.prefetcher = DevicePrefetcher(self.loader,
                                            sharding=self.batch_sharding,
                                            depth=cfg.prefetch)
@@ -572,6 +591,9 @@ class Trainer:
             # ref: train.py:84
             events.emit_audit(logger, AUDIT_START, "start", step=0,
                               tokens_per_step=tokens_per_step)
+        if self._compile_event is not None:
+            events.emit("compile", **self._compile_event)
+            self._compile_event = None
 
         if cfg.profile_dir and not cfg.trace_steps:
             # bare --profile-dir keeps its whole-run capture; --trace-steps

@@ -128,6 +128,11 @@ class TrainConfig:
     # store (exported as ftl_host_heartbeat_* gauges); 0 = off. Only
     # active when --metrics-port is set (the gauges need a scraper).
     heartbeat_seconds: float = 10.0
+    # JAX persistent compilation cache directory (utils/compile_cache.py);
+    # "" = off. A warm cache turns the restart-after-preemption compile
+    # into a disk read — the build time lands in the flight recorder
+    # either way, so goodput reports show cold vs warm directly.
+    compile_cache_dir: str = ""
     resubmit_command: str = ""  # override for tests; default: sbatch $WORKDIR/train.sh
     distributed: bool = False  # call jax.distributed.initialize() (multi-host pods)
 
@@ -346,6 +351,11 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
     parser.add_argument("--heartbeat-seconds", type=float, default=10.0,
                         help="Per-host heartbeat publish interval (KV "
                              "store; ftl_host_heartbeat_* gauges); 0 = off")
+    parser.add_argument("--compile-cache-dir", type=str, default="",
+                        help="JAX persistent compilation cache directory; "
+                             "'' = off. Warm restarts skip the train-step "
+                             "XLA compile; build time is logged cold vs "
+                             "warm through the flight recorder")
     parser.add_argument("--resubmit-command", type=str, default="",
                         help="Override the self-resubmit command (tests); "
                              "default: sbatch $WORKDIR/train.sh $SLURM_JOB_ID")

@@ -56,7 +56,10 @@ def main():
     p.add_argument("--vocab-size", type=int, default=0)
     p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
     p.add_argument("--scenario", default="uniform",
-                   choices=("uniform", "long_context"))
+                   choices=("uniform", "long_context", "spec_decode"))
+    p.add_argument("--spec-ks", default="2,4,8,12",
+                   help="spec_decode scenario: comma-separated draft "
+                        "depths to sweep")
     p.add_argument("--slots", type=int, default=4,
                    help="decode slots (long_context: the RING config's "
                         "slot count, which sets the cache memory budget)")
@@ -128,13 +131,16 @@ def main():
 
     if args.scenario == "long_context":
         result = _long_context(args, build, reqs)
+    elif args.scenario == "spec_decode":
+        result = _spec_decode(args, reqs, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
 
     print(json.dumps(result))
-    default_name = ("BENCH_decode_paged" if args.scenario == "long_context"
-                    else f"BENCH_decode_{args.model}")
+    default_name = {"long_context": "BENCH_decode_paged",
+                    "spec_decode": "BENCH_decode_spec"}.get(
+        args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         f"{default_name}_{backend}.json")
@@ -268,6 +274,174 @@ def _long_context(args, build, reqs):
         "ring": ring_summary,
         "concurrency_gain": round(
             pm["max_concurrent"] / max(rm["max_concurrent"], 1), 2),
+    }
+
+
+def _spec_decode(args, reqs, vocab):
+    """Speculative vs plain greedy decode at the SAME cache memory budget.
+
+    Target: ``tiny-4l`` with layers 2/3's output projections (attention wo,
+    ffn w2) zeroed — those blocks become exact residual identities. Draft:
+    the 2-layer ``tiny`` preset SHARING the target's embeddings, first two
+    layers, final norm and output head, so draft logits equal target
+    logits and greedy acceptance is ~100% — the regime a distilled draft
+    approaches. One extra point with an INDEPENDENTLY-initialized draft
+    shows the low-acceptance floor.
+
+    Both verify implementations are swept (engine ``spec_verify_impl``):
+
+    - ``chunk`` points carry the CPU-visible throughput win — one
+      (slots, k+1) forward batches the verify FLOPs into one GEMM pass.
+      Greedy streams are COMPARED against the baseline and the mismatch
+      count recorded, not asserted: bf16 GEMM accumulation is shape-
+      dependent, and over ~6k greedy positions a one-ulp logit near-tie
+      occasionally flips an argmax between the S=k+1 and S=1 programs.
+    - the ``exact`` point (mid k) micro-steps k+1 S=1 forwards inside the
+      verify program — same shapes as the decode step, so its stream is
+      ASSERTED bit-equal to the baseline. Its win is dispatch
+      elimination (1 verify program per round vs k+1 decode dispatches),
+      which pays on accelerators but is invisible on CPU where dispatch
+      is ~free next to compute — expect ~1x here, by design.
+
+    Cache memory is held fixed in LAYER-blocks (one (block, heads, bs,
+    head_dim) K+V block pair per layer): baseline 72 usable blocks x 4
+    layers = 288; spec 48 x 4 (target) + 48 x 2 (draft) = 288 — and both
+    admit the same 4-way concurrency (12 blocks/request at prompt 32 +
+    gen 160, block size 16; the 4 slots are the binding cap on both
+    sides). The long decode phase is the point: the spec side pays
+    prefill TWICE (target + draft pools), so short generations understate
+    the steady-state decode win.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    # seq_len=256: the tiny presets ship 128, too short for the 192-token
+    # requests below (RoPE table length; parameters are unaffected)
+    tcfg = get_config("tiny-4l", vocab_size=vocab, seq_len=256)
+    dcfg = get_config("tiny", vocab_size=vocab, seq_len=256)
+    model = Transformer(tcfg)
+    tparams = model.init(jax.random.PRNGKey(args.seed),
+                         jnp.zeros((1, tcfg.seq_len), jnp.int32))["params"]
+    tparams = jax.tree_util.tree_map(lambda x: x, dict(tparams))
+    for lyr in ("layers_2", "layers_3"):
+        for mod, proj in (("attention", "wo"), ("feed_forward", "w2")):
+            node = dict(tparams[lyr][mod][proj])
+            for leaf in node:
+                node[leaf] = jnp.zeros_like(node[leaf])
+            tparams[lyr] = dict(tparams[lyr])
+            tparams[lyr][mod] = dict(tparams[lyr][mod])
+            tparams[lyr][mod][proj] = node
+    dparams = {k: tparams[k] for k in ("tok_embeddings", "norm", "output",
+                                       "layers_0", "layers_1")}
+    rand_draft = Transformer(dcfg).init(
+        jax.random.PRNGKey(args.seed + 1),
+        jnp.zeros((1, dcfg.seq_len), jnp.int32))["params"]
+
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Request
+
+    prompt_len, gen, slots, bs = 32, 160, 4, 16
+    max_len = prompt_len + gen
+    base_usable, spec_usable = 72, 48
+    common = dict(slots=slots, max_len=max_len, prefill_buckets=(16, 32),
+                  kv_layout="paged", kv_block_size=bs)
+    request_specs = [(prompt_len, gen)] * args.requests
+
+    def fixed_reqs(tag):
+        # every engine must see the IDENTICAL prompt set or the bit-match
+        # assertion compares different streams (the shared module-level rng
+        # advances per call)
+        lrng = np.random.default_rng(args.seed + 123)
+        return [Request(id=f"{tag}{i}",
+                        prompt=lrng.integers(3, vocab, size=pl).tolist(),
+                        max_new_tokens=g)
+                for i, (pl, g) in enumerate(request_specs)]
+
+    def run(engine):
+        _run_stream(engine, reqs(request_specs[:2], "warm"))
+        engine.reset()
+        return _run_stream(engine, reqs(request_specs, "req"))
+
+    base = InferenceEngine(tcfg, tparams, kv_num_blocks=base_usable + 1,
+                           **common)
+    bm = run(base)
+    base_streams = None
+    sched_probe = None
+    # capture baseline token streams for the bit-match assertion
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Scheduler
+    base.reset()
+    sched_probe = Scheduler(base, eos_token_id=None)
+    for r in fixed_reqs("bit"):
+        sched_probe.submit(r)
+    base_streams = {c.request_id: c.tokens for c in sched_probe.run()}
+    base = None
+
+    points = []
+    ks = [int(k) for k in args.spec_ks.split(",")]
+    mid_k = ks[len(ks) // 2]
+    sweep = ([(k, dparams, "shared-prefix", "chunk") for k in ks]
+             + [(mid_k, dparams, "shared-prefix", "exact"),
+                (mid_k, rand_draft, "random", "chunk")])
+    for k, draft, tag, impl in sweep:
+        eng = InferenceEngine(tcfg, tparams, draft_cfg=dcfg,
+                              draft_params=draft, spec_k=k,
+                              kv_num_blocks=spec_usable + 1,
+                              draft_num_blocks=spec_usable + 1,
+                              spec_verify_impl=impl, **common)
+        m = run(eng)
+        eng.reset()
+        sched = Scheduler(eng, eos_token_id=None)
+        for r in fixed_reqs("bit"):
+            sched.submit(r)
+        streams = {c.request_id: c.tokens for c in sched.run()}
+        mismatched = sum(streams[rid] != base_streams[rid]
+                         for rid in base_streams)
+        bit_match = mismatched == 0
+        if impl == "exact":
+            # the tentpole invariant: micro-step verify shares the decode
+            # program's op shapes, so this holds by construction, not by
+            # luck of the backend's GEMM tiling
+            assert bit_match, (
+                f"exact-impl spec k={k} ({tag}) diverged from greedy "
+                f"baseline in {mismatched} stream(s)")
+        points.append({
+            "k": k,
+            "draft": tag,
+            "verify_impl": impl,
+            "tokens_per_sec": round(m["tokens_per_sec"], 1),
+            "speedup_vs_baseline": round(
+                m["tokens_per_sec"] / bm["tokens_per_sec"], 2),
+            "acceptance_rate": round(m["spec_acceptance_rate"], 3),
+            "spec_rounds": m["spec_rounds"],
+            "decode_p50_ms": round(m["decode_p50_ms"], 3),
+            "bit_match_greedy": bit_match,
+            "mismatched_streams": mismatched,
+        })
+        eng = None
+
+    best = max((p for p in points if p["draft"] == "shared-prefix"
+                and p["verify_impl"] == "chunk"),
+               key=lambda p: p["speedup_vs_baseline"])
+    return {
+        "metric": (f"speculative decode speedup (tiny-4l target, tiny "
+                   f"draft, prompt {prompt_len}, gen {gen}, "
+                   f"{slots} slots, fixed layer-block budget, chunk "
+                   f"verify, backend {jax.default_backend()})"),
+        "value": best["speedup_vs_baseline"],
+        "unit": "x tokens/sec vs non-spec baseline",
+        "baseline_tokens_per_sec": round(bm["tokens_per_sec"], 1),
+        "baseline_decode_p50_ms": round(bm["decode_p50_ms"], 3),
+        "layer_block_budget": {"baseline": base_usable * 4,
+                               "spec": spec_usable * 4 + spec_usable * 2},
+        "kv_blocks": {"baseline": base_usable,
+                      "spec_target": spec_usable, "spec_draft": spec_usable},
+        "points": points,
     }
 
 

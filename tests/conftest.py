@@ -76,6 +76,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second CPU tests (multi-round speculative streams, "
+        "big layout matrices); tier-1 runs -m 'not slow'")
+
+
 _MP_PROBE_WORKER = """
 import os, sys
 os.environ.pop('PALLAS_AXON_POOL_IPS', None)

@@ -1,0 +1,349 @@
+"""Speculative decoding (inference/ spec mode): four layers of evidence.
+
+1. kernel — ``spec_accept`` degenerates to exact argmax matching for
+   greedy rows, and for sampled rows its emitted tokens follow the TARGET
+   distribution in closed form (the Leviathan/Chen guarantee) on a
+   3-token toy vocab;
+2. numerics — ``verify_with_cache``'s chunked scoring agrees with the
+   sequential S=1 steps it replaces (argmax + allclose on an fp32 model;
+   the engine's AOT verify program micro-steps S=1 shapes precisely so
+   this agreement is bitwise in production — engine.py ``_verify_fn``);
+3. streams — a greedy speculative stream is BIT-identical to the
+   non-speculative paged path across chunked prefill and block-pool
+   eviction/refill (slow: builds two real engines);
+4. lifecycle — dual-pool admission/rollback/double-free contracts and
+   mid-prompt drain exactness, pinned against a fake spec engine.
+
+Module scope imports nothing from the package: the collect-only guard at
+the bottom asserts NO test module pays the draft path's import cost (or
+any inference/ import) at collection time.
+"""
+
+import ast
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+CACHE = "/tmp/jax_test_compile_cache"
+
+
+# ------------------------------------------------------- 1. accept kernel
+def test_spec_accept_greedy_is_exact_argmax_matching():
+    """With temperature <= 0 both q and p are one-hots: the accept test
+    ``u * q(d) < p(d)`` keeps exactly the leading run of draft tokens that
+    equal the target argmax, and the bonus/correction token IS the target
+    argmax at the first divergence — so greedy needs no randomness and the
+    emitted prefix equals what sequential argmax decoding would produce."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.sampler import spec_accept
+
+    rng = np.random.default_rng(0)
+    v, k = 7, 3
+    for trial in range(50):
+        target_logits = rng.normal(size=(k + 1, v)).astype(np.float32)
+        draft_tokens = rng.integers(0, v, size=k).astype(np.int32)
+        # greedy draft distributions are one-hots at the proposal
+        draft_probs = np.eye(v, dtype=np.float32)[draft_tokens]
+        out, acc = spec_accept(
+            jnp.asarray(draft_tokens), jnp.asarray(draft_probs),
+            jnp.asarray(target_logits),
+            jax.random.PRNGKey(trial), jnp.float32(0.0), jnp.float32(1.0))
+        argmax = target_logits.argmax(axis=-1)
+        expect_a = 0
+        while expect_a < k and draft_tokens[expect_a] == argmax[expect_a]:
+            expect_a += 1
+        assert int(acc) == expect_a
+        expected = list(draft_tokens[:expect_a]) + [argmax[expect_a]]
+        assert np.asarray(out)[: expect_a + 1].tolist() == expected
+
+
+def test_spec_rejection_sampling_matches_target_distribution():
+    """k=1 on a 3-token vocab with draft law q != target law p: across many
+    independent rounds the emitted first token must be distributed as p
+    EXACTLY (not as q, not as some blend), and the acceptance probability
+    equals sum_a min(p_a, q_a) — the closed forms from Leviathan et al.
+    2023, Thm 1. Empirical check at ~4 sigma on 8000 trials."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.sampler import spec_accept
+
+    q = jnp.asarray([0.5, 0.3, 0.2], jnp.float32)
+    p = np.array([0.2, 0.5, 0.3], np.float32)
+    target_logits = jnp.log(jnp.asarray(p))[None, :].repeat(2, axis=0)
+    n = 8000
+
+    def one_round(key):
+        kd, ka = jax.random.split(key)
+        d = jax.random.categorical(kd, jnp.log(q)).astype(jnp.int32)
+        out, acc = spec_accept(d[None], q[None, :], target_logits, ka,
+                               jnp.float32(1.0), jnp.float32(1.0))
+        return out[0], (acc > 0).astype(jnp.int32)
+
+    keys = jax.random.split(jax.random.PRNGKey(42), n)
+    toks, accepted = jax.jit(jax.vmap(one_round))(keys)
+    toks, accepted = np.asarray(toks), np.asarray(accepted)
+
+    emp = np.bincount(toks, minlength=3) / n
+    se = np.sqrt(p * (1 - p) / n)
+    np.testing.assert_allclose(emp, p, atol=float((4 * se).max()))
+    accept_rate = accepted.mean()
+    expect_accept = float(np.minimum(p, np.asarray(q)).sum())
+    se_a = np.sqrt(expect_accept * (1 - expect_accept) / n)
+    assert abs(accept_rate - expect_accept) < 4 * se_a
+
+
+# ---------------------------------------------------- 2. verify-k numerics
+def test_verify_chunk_scores_agree_with_sequential_steps():
+    """``verify_with_cache`` scores (B, k+1) candidates in one forward; its
+    row j must agree with the j-th sequential S=1 ``forward_with_cache``
+    step on the same committed prefix — same masked attention, same
+    positions. On an fp32 model the two differ only by shape-dependent
+    matmul accumulation order, so argmax equality plus allclose pins the
+    contract (the engine's AOT verify program micro-steps the S=1 shapes
+    exactly, making this agreement bitwise in production)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.kv_cache import (
+        init_paged_cache)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    cfg = get_config("tiny", vocab_size=64, seq_len=64,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(3, 64, size=(1, 8)), jnp.int32)
+    cand = jnp.asarray(rng.integers(3, 64, size=(1, 3)), jnp.int32)
+
+    bs = 8
+    cache = init_paged_cache(cfg, slots=1, max_len=32, block_size=bs)
+    tables = jnp.arange(1, 32 // bs + 1, dtype=jnp.int32)[None, :]
+    _, (k0, v0) = model.apply(
+        {"params": params}, prompt, cache.k, cache.v,
+        jnp.zeros((1,), jnp.int32), block_tables=tables,
+        method="forward_with_cache")
+
+    offsets = jnp.full((1,), 8, jnp.int32)
+    chunk, _ = model.apply(
+        {"params": params}, cand, k0, v0, offsets, block_tables=tables,
+        method="verify_with_cache")
+
+    ck, cv, rows = k0, v0, []
+    for j in range(3):
+        step, (ck, cv) = model.apply(
+            {"params": params}, cand[:, j:j + 1], ck, cv, offsets + j,
+            block_tables=tables, method="forward_with_cache")
+        rows.append(np.asarray(step)[:, 0])
+    seq_logits = np.stack(rows, axis=1)
+
+    np.testing.assert_allclose(np.asarray(chunk), seq_logits,
+                               rtol=1e-5, atol=1e-5)
+    assert (np.asarray(chunk).argmax(-1) == seq_logits.argmax(-1)).all()
+
+
+# ----------------------------------------------------- 3. stream equality
+@pytest.mark.slow
+def test_greedy_spec_stream_bitmatches_nonspec_paged():
+    """End to end: the same request set (chunked long prompts, more
+    requests than the block pools admit at once, so slots evict and refill
+    into reused blocks) generates BIT-identical greedy token streams with
+    and without speculation — the tentpole invariant. The draft is an
+    independently-initialized model, so acceptance is poor: exactness must
+    come from the verify/commit path, not from a lucky good draft."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine, enable_compilation_cache)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    enable_compilation_cache(CACHE)
+    cfg = get_config("tiny", vocab_size=64, seq_len=64)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    draft_params = Transformer(cfg).init(
+        jax.random.PRNGKey(9),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+
+    rng = np.random.default_rng(5)
+    lens = [20, 9, 36, 13, 20, 5]  # 36 and 20 exceed the 16 bucket: chunked
+    reqs = [(rng.integers(3, 64, size=n).tolist(), 10) for n in lens]
+    kw = dict(slots=2, max_len=48, prefill_buckets=(16,), kv_layout="paged",
+              kv_block_size=16, kv_num_blocks=7)  # 6 usable: 2 concurrent
+
+    def streams(engine):
+        sched = Scheduler(engine, eos_token_id=None)
+        for i, (prompt, gen) in enumerate(reqs):
+            sched.submit(Request(id=f"r{i}", prompt=prompt,
+                                 max_new_tokens=gen))
+        done = sched.run()
+        assert len(done) == len(reqs)
+        return {c.request_id: c.tokens for c in done}, sched
+
+    base = InferenceEngine(cfg, params, **kw)
+    want, _ = streams(base)
+    del base
+
+    spec = InferenceEngine(cfg, params, draft_cfg=cfg,
+                           draft_params=draft_params, spec_k=2,
+                           draft_num_blocks=7, **kw)
+    got, sched = streams(spec)
+    assert got == want
+    # both pools fully drained back to the free lists
+    assert sched.allocator.free_count == sched.allocator.capacity
+    assert sched.draft_allocator.free_count == sched.draft_allocator.capacity
+    m = sched.metrics()
+    assert m["spec_rounds"] > 0 and m["spec_draft_tokens"] > 0
+
+
+# ------------------------------------------------ 4. dual-pool lifecycle
+class _FakeSpecEngine:
+    """Host-side double of the spec engine: chunked prefill that consults
+    ``stop_check`` between chunks, and accept-all spec rounds. Lets the
+    scheduler's dual-pool bookkeeping be pinned without any compiles."""
+
+    kv_layout = "paged"
+
+    def __init__(self, slots=2, block_size=4, num_blocks=13,
+                 draft_num_blocks=13, spec_k=2, max_len=32):
+        self.slots, self.block_size = slots, block_size
+        self.num_blocks, self.draft_num_blocks = num_blocks, draft_num_blocks
+        self.spec_k, self.max_len = spec_k, max_len
+        self.max_blocks_per_slot = -(-max_len // block_size)
+        self.prefill_chunk = 4
+
+    def prefill(self, slot, prompt, block_row=None, draft_block_row=None,
+                temperature=0.0, top_p=1.0, seed=0, stop_check=None,
+                on_chunk=None):
+        start = 0
+        while start < len(prompt):
+            if on_chunk is not None:
+                on_chunk()
+            start += self.prefill_chunk
+            if start < len(prompt) and stop_check is not None and stop_check():
+                return None  # drain fired between chunks
+        return 1
+
+    def spec_round(self, tokens, lengths, active, temperature, top_p, seeds,
+                   steps, block_tables=None, draft_block_tables=None):
+        out = np.full((self.slots, self.spec_k + 1), 2, np.int32)
+        acc = np.full((self.slots,), self.spec_k, np.int32)
+        return out, acc
+
+
+def test_mid_prompt_drain_frees_both_pools_and_reports_unserved():
+    """A drain signal landing BETWEEN prefill chunks must abort the
+    admission, free the target AND draft blocks it grabbed, report the
+    request unserved, and let already-active requests run to completion —
+    the signal-drain exactness contract extended to the dual-pool mode."""
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+
+    eng = _FakeSpecEngine(slots=2)
+    chunks = {"n": 0}
+    sched = Scheduler(eng, eos_token_id=None,
+                      stop_check=lambda: chunks["n"] >= 2)
+    orig = sched._count_chunk
+
+    def counting():
+        chunks["n"] += 1
+        orig()
+
+    sched._count_chunk = counting
+    sched.submit(Request(id="short", prompt=[1] * 4, max_new_tokens=6))
+    sched.submit(Request(id="long", prompt=[1] * 12, max_new_tokens=6))
+    done = sched.run()
+
+    assert [c.request_id for c in done] == ["short"]
+    assert [r.id for r in sched.unserved()] == ["long"]
+    assert not sched.admission_open
+    # every block of both pools is back on the free lists; the long
+    # request's partial grab did not leak
+    assert sched.allocator.free_count == sched.allocator.capacity
+    assert sched.draft_allocator.free_count == sched.draft_allocator.capacity
+    assert (sched.block_tables == 0).all()
+    assert (sched.draft_block_tables == 0).all()
+    # the accept-all fake banks k+1 tokens per round: 2 rounds for 6
+    sc = done[0]
+    assert sc.spec_proposed > 0 and sc.spec_emitted_not_proposed > 0
+
+
+def test_draft_pool_shortage_rolls_back_target_grab():
+    """Combined-footprint admission: when the draft pool cannot cover the
+    head of the queue, the target blocks already grabbed for it must be
+    returned immediately (not stranded until the request eventually
+    admits), and the request waits FIFO until BOTH pools can cover it."""
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+
+    # target pool covers two 3-block requests, draft pool only one
+    eng = _FakeSpecEngine(slots=2, num_blocks=13, draft_num_blocks=4,
+                          max_len=12)
+    sched = Scheduler(eng, eos_token_id=None)
+    sched.submit(Request(id="a", prompt=[1] * 6, max_new_tokens=6))
+    sched.submit(Request(id="b", prompt=[1] * 6, max_new_tokens=6))
+    sched.step()
+    assert len(sched.active) == 1
+    # b's aborted admission left NO target blocks allocated beyond a's
+    assert (sched.allocator.used_count
+            == sched._blocks_needed(sched.active[0].request))
+    done = sched.run()
+    assert {c.request_id for c in done} == {"a", "b"}
+    assert sched.allocator.free_count == sched.allocator.capacity
+    assert sched.draft_allocator.free_count == sched.draft_allocator.capacity
+
+
+def test_block_allocator_double_free_raises():
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        BlockAllocator)
+
+    alloc = BlockAllocator(num_blocks=5)
+    blocks = alloc.alloc(3)
+    assert blocks is not None and alloc.free_count == 1
+    assert alloc.alloc(2) is None  # exhaustion queues, never crashes
+    alloc.free(blocks)
+    assert alloc.free_count == alloc.capacity
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free(blocks)
+
+
+# ------------------------------------------------- 5. collect-only guard
+def test_no_test_module_imports_inference_at_module_scope():
+    """Collecting the test suite must not import the inference package
+    (and with it jax program-building code): every test imports it inside
+    the test function. Walks only module-scope statements — imports inside
+    functions are the sanctioned pattern."""
+    offenders = []
+    for path in sorted((REPO / "tests").glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        stack = list(tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.If, ast.Try)):
+                stack.extend(ast.iter_child_nodes(node))
+                continue
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name.startswith("fault_tolerant_llm_training_tpu"
+                                   ".inference"):
+                    offenders.append(f"{path.name}: {name}")
+    assert not offenders, (
+        "module-scope inference/ imports break collect-time isolation: "
+        f"{offenders}")
