@@ -1,0 +1,17 @@
+"""Chaos subsystem: declarative fault-injection schedules.
+
+The paper's product is the preempt -> checkpoint -> resubmit -> resume
+loop; this package is how we *attack* it on purpose. A schedule string
+(``--chaos "step=50:sigusr1;step=80:exception"``, utils/config.py) parses
+into seeded deterministic injectors (injector.py) that hook the training
+loop, the signal layer, the data prefetcher, the multihost KV agreement
+and the serving loop. ``scripts/chaos_campaign.py`` drives whole
+inject -> die -> resume -> verify scenarios end-to-end and writes a
+survival report from the flight-recorder trail.
+"""
+
+from .schedule import ChaosEntry, FAULTS, SERVE_FAULTS, parse_schedule
+from .injector import ChaosInjector
+
+__all__ = ["ChaosEntry", "ChaosInjector", "FAULTS", "SERVE_FAULTS",
+           "parse_schedule"]

@@ -1,0 +1,230 @@
+"""Seeded deterministic fault injectors.
+
+One :class:`ChaosInjector` owns the parsed schedule (chaos/schedule.py) and
+exposes one hook per injection site:
+
+- :meth:`on_train_step` — the training loop's single injection point
+  (training/loop.py; the legacy ``--raise-error`` block now lives here):
+  signal delivery, simulated exceptions, checkpoint-corruption faults;
+- :meth:`on_sync_boundary` — the multihost KV signal-agreement boundary:
+  delayed (``kv_delay``) or failed (``kv_fail``) rounds;
+- :meth:`on_batch` — the data-prefetch worker (data/prefetch.py), keyed by
+  the batch's global step: ``loader_stall`` sleeps before handing over;
+- :meth:`on_serve_step` — the serving loop (inference/serve.py), keyed by
+  decode iteration: a mid-decode drain signal;
+- :meth:`post_fault_save` — ft/handler.py, after the exit handler's fault
+  checkpoint commits: ``ckpt_corrupt`` flips bytes in the newest step dir
+  (AFTER its integrity manifest is written, so the next restore must catch
+  it and fall back).
+
+Every firing is recorded three ways at once: the ``AUDIT_CHAOS_INJECT_FMT``
+audit line, one flight-recorder event typed ``chaos_<fault>``
+(obs/events.py), and the ``chaos_faults_injected_total{class=...}``
+counter. Signals are delivered through the OS (:func:`ft.signals.inject`)
+so the handler, the flag, and the cluster agreement run exactly as for a
+scheduler-sent signal. The injector is seeded: which byte of which file a
+``ckpt_corrupt`` flips is a deterministic function of ``--seed``.
+"""
+
+import os
+import signal as _signal
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..ft import signals as ft_signals
+from ..obs import events
+from ..obs.registry import REGISTRY
+from ..utils.logging import AUDIT_CHAOS_INJECT_FMT, logger
+from .schedule import ChaosEntry, parse_schedule
+
+# The reference's injected-error shape (ref: train.py:112-113): args[1] == -1
+# routes the exit policy down "save, no resubmit" (ft/handler.py).
+_SIM_ERROR_MSG = "Simulated exception to test signal handler"
+
+_M_INJECTED = REGISTRY.counter(
+    "chaos_faults_injected_total",
+    "Chaos faults injected by this process, by fault class")
+
+
+class ChaosInjector:
+    def __init__(self, entries: List[ChaosEntry], seed: int = 0):
+        self.entries = entries
+        self.rng = np.random.default_rng(seed)
+        self._corrupt_armed: Optional[ChaosEntry] = None
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["ChaosInjector"]:
+        """Build from TrainConfig: ``--chaos`` plus the legacy
+        ``--raise-error`` alias (one ``exception`` entry at ``--error-step``
+        carrying ``--error-local-rank``) — the injection site lives here in
+        one place either way."""
+        entries = parse_schedule(getattr(cfg, "chaos", ""))
+        if getattr(cfg, "raise_error", False):
+            entries.append(ChaosEntry(step=cfg.error_step, fault="exception",
+                                      rank=cfg.error_local_rank))
+        if not entries:
+            return None
+        return cls(sorted(entries, key=lambda e: (e.step, e.fault)),
+                   seed=getattr(cfg, "seed", 0))
+
+    def describe(self) -> str:
+        parts = []
+        for e in self.entries:
+            tok = f"step={e.step}:{e.fault}"
+            if e.arg is not None:
+                tok += f"={e.arg:g}s"
+            if e.rank >= 0:
+                tok += f"@rank={e.rank}"
+            parts.append(tok)
+        return "; ".join(parts)
+
+    # ------------------------------------------------------------- internals
+    def _pending(self, faults, step: int) -> List[ChaosEntry]:
+        return [e for e in self.entries
+                if not e.fired and e.fault in faults and e.step == step]
+
+    def _fire(self, entry: ChaosEntry, **payload) -> None:
+        """Latch the entry and record the injection everywhere at once —
+        before the fault itself acts, so a fault that kills the process
+        still leaves its own trail."""
+        entry.fired = True
+        _M_INJECTED.labels(**{"class": entry.fault}).inc()
+        events.emit_audit(
+            logger,
+            AUDIT_CHAOS_INJECT_FMT.format(fault=entry.fault,
+                                          step=entry.step),
+            f"chaos_{entry.fault}", step=entry.step, fault=entry.fault,
+            **payload)
+        events.flush()
+
+    def _raise_error(self, trainer, entry: ChaosEntry) -> None:
+        """The reference's simulated-error semantics, byte-identical to the
+        old in-loop block: replicated (rank < 0) drains the dispatch
+        pipeline and marks the error cluster-replicated so the exit handler
+        may save coordinated; a rank-restricted fault raises on that host
+        only, undrained — the shape that exercises the pod fault fence."""
+        if entry.rank < 0:
+            self._fire(entry, rank=-1)
+            if trainer is not None:
+                trainer._drain_inflight()
+                trainer.error_is_replicated = True
+            raise Exception(_SIM_ERROR_MSG, -1)
+        import jax
+
+        if entry.rank == jax.process_index():
+            self._fire(entry, rank=entry.rank)
+            raise Exception(_SIM_ERROR_MSG, -1)
+        entry.fired = True  # not this host's fault to raise
+
+    # ----------------------------------------------------------------- hooks
+    def on_train_step(self, trainer, step: int) -> None:
+        """Training-loop injection site: called once per loop iteration
+        while ``training_step == step`` (after the step's dispatch, before
+        the counter advances) — the exact point the legacy ``--raise-error``
+        fired from."""
+        for e in self._pending(("sigusr1", "sigterm"), step):
+            if 0 <= e.rank != _process_index():
+                e.fired = True
+                continue
+            signum = (_signal.SIGUSR1 if e.fault == "sigusr1"
+                      else _signal.SIGTERM)
+            self._fire(e, signum=int(signum))
+            ft_signals.inject(signum)
+        for e in self._pending(("ckpt_corrupt",), step):
+            # Two-phase fault: die like a training error now (the exit
+            # handler saves the fault checkpoint), corrupt that checkpoint
+            # in post_fault_save once it has committed.
+            self._fire(e, phase="raise")
+            self._corrupt_armed = e
+            if trainer is not None:
+                trainer._drain_inflight()
+                trainer.error_is_replicated = True
+            raise Exception(_SIM_ERROR_MSG, -1)
+        for e in self._pending(("exception",), step):
+            self._raise_error(trainer, e)
+
+    def on_sync_boundary(self, trainer, step: int) -> None:
+        """Signal-sync boundary: delayed or failed KV agreement rounds."""
+        from ..ft.multihost import PeerHostError
+
+        for e in self._pending(("kv_delay",), step):
+            self._fire(e, seconds=e.arg)
+            time.sleep(e.arg or 0.0)
+        for e in self._pending(("kv_fail",), step):
+            self._fire(e)
+            if trainer is not None:
+                trainer.error_is_replicated = True
+            raise PeerHostError()
+
+    def on_batch(self, batch_step: int) -> None:
+        """Prefetch-worker hook (data/prefetch.py), called with the global
+        step the produced batch will feed, BEFORE it is handed to the
+        consumer: ``loader_stall`` delays that batch's delivery."""
+        for e in self._pending(("loader_stall",), batch_step):
+            self._fire(e, seconds=e.arg)
+            time.sleep(e.arg or 0.0)
+
+    def on_serve_step(self, iteration: int) -> None:
+        """Serving-loop hook, keyed by decode iteration: deliver the drain
+        signal mid-decode; the serve loop's next flag check begins the
+        drain lifecycle."""
+        for e in self._pending(("sigusr1", "sigterm"), iteration):
+            signum = (_signal.SIGUSR1 if e.fault == "sigusr1"
+                      else _signal.SIGTERM)
+            self._fire(e, signum=int(signum), serve=True)
+            ft_signals.inject(signum)
+
+    def post_fault_save(self, checkpoint_dir: str, saved_step: int,
+                        log) -> Optional[str]:
+        """Corrupt the just-committed fault checkpoint (armed by a
+        ``ckpt_corrupt`` raise). Flips one byte mid-file in a seeded-chosen
+        state file of step ``saved_step`` — after the integrity manifest
+        was written, so the corruption is exactly what the next restore's
+        verification must catch. Returns the corrupted path (or None)."""
+        if self._corrupt_armed is None or saved_step is None:
+            return None
+        entry, self._corrupt_armed = self._corrupt_armed, None
+        step_dir = os.path.join(checkpoint_dir, str(saved_step))
+        candidates = []
+        for root, _dirs, names in os.walk(step_dir):
+            for name in names:
+                if name == "integrity.json" or name.startswith("."):
+                    continue
+                path = os.path.join(root, name)
+                if os.path.getsize(path) > 0:
+                    candidates.append(path)
+        # Prefer real array payloads over small JSON metadata: corrupting
+        # the largest-file cohort models a torn/bit-rotted shard write.
+        state_files = sorted(c for c in candidates
+                             if f"{os.sep}state{os.sep}" in c)
+        pool = state_files or sorted(candidates)
+        if not pool:
+            log.warning(f"[CHAOS] ckpt_corrupt armed but no files found "
+                        f"under {step_dir}")
+            return None
+        target = pool[int(self.rng.integers(len(pool)))]
+        size = os.path.getsize(target)
+        offset = int(self.rng.integers(size))
+        with open(target, "r+b") as fh:
+            fh.seek(offset)
+            byte = fh.read(1)
+            fh.seek(offset)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+            fh.flush()
+            os.fsync(fh.fileno())
+        rel = os.path.relpath(target, checkpoint_dir)
+        log.info(f"[CHAOS] Corrupted checkpoint step {saved_step}: "
+                 f"flipped byte {offset} of {rel}")
+        events.emit(kind="chaos_ckpt_corrupt", step=entry.step,
+                    phase="corrupted", saved_step=int(saved_step),
+                    file=rel, offset=offset)
+        events.flush()
+        return target
+
+
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()

@@ -1,0 +1,169 @@
+"""Declarative fault-schedule grammar.
+
+One schedule entry names one fault at one global step::
+
+    step=<N>:<fault>[=<arg>][@rank=<R>]
+
+entries separated by ``;``. Examples:
+
+    --chaos "step=50:sigusr1"
+    --chaos "step=80:exception@rank=1"
+    --chaos "step=120:ckpt_corrupt;step=140:loader_stall=5s"
+
+``--chaos`` also accepts a JSON file path (detected by an existing file or
+an ``@`` prefix) holding a list of ``{"step": N, "fault": "...",
+"arg": ..., "rank": ...}`` objects — the form campaign runners generate.
+
+Fault classes (each hooks a different layer — chaos/injector.py):
+
+==============  ============================================================
+sigusr1         deliver a real SIGUSR1 to this process (the Slurm
+                pre-timeout warning; exercises ft/signals.py + the
+                save-and-resubmit exit policy)
+sigterm         deliver a real SIGTERM (scancel; the no-save policy)
+exception       raise the reference's simulated training error at the
+                injection site in training/loop.py (``--raise-error`` is a
+                thin alias for one of these entries)
+ckpt_corrupt    raise a training error AND, after the exit handler's fault
+                checkpoint commits, flip bytes in its newest step dir —
+                the resume must detect it (integrity manifest,
+                checkpoint/manager.py) and fall back to the previous
+                passing checkpoint
+loader_stall    sleep the data-prefetch worker before handing over the
+                batch for the given step (arg = duration, default 2s)
+kv_delay        sleep at a signal-sync boundary, simulating a slow
+                multihost KV agreement round (arg = duration, default 1s)
+kv_fail         raise PeerHostError at a sync boundary, simulating a
+                failed agreement round / lost peer
+==============  ============================================================
+
+Steps are *global* training steps, so an entry in the past at resume time
+never re-fires: a resumed job naturally continues clean. Durations accept
+``5s``, ``250ms`` or a bare float (seconds).
+"""
+
+import dataclasses
+import json
+import os
+import re
+from typing import List, Optional, Sequence
+
+# arg = None: no argument allowed; float: required/defaulted duration (s)
+FAULTS = {
+    "sigusr1": None,
+    "sigterm": None,
+    "exception": None,
+    "ckpt_corrupt": None,
+    "loader_stall": 2.0,
+    "kv_delay": 1.0,
+    "kv_fail": None,
+}
+
+# The serving loop has no training steps, prefetcher or KV agreement: only
+# the signal faults make sense there (a mid-decode drain).
+SERVE_FAULTS = ("sigusr1", "sigterm")
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
+_ENTRY_RE = re.compile(
+    r"^step=(?P<step>-?\d+):(?P<fault>[a-z_0-9]+)"
+    r"(?:=(?P<arg>[^@]+))?(?:@rank=(?P<rank>-?\d+))?$")
+
+
+@dataclasses.dataclass
+class ChaosEntry:
+    """One scheduled injection. ``fired`` latches after the injector acts:
+    every entry fires exactly once per process lifetime."""
+
+    step: int
+    fault: str
+    arg: Optional[float] = None  # seconds, for duration faults
+    rank: int = -1  # -1 = every process; >=0 = that process index only
+    fired: bool = False
+
+
+def parse_duration(text: str) -> float:
+    m = _DURATION_RE.match(str(text).strip())
+    if not m:
+        raise ValueError(
+            f"bad chaos duration {text!r} (want e.g. '5s', '250ms' or a "
+            f"bare seconds float)")
+    value = float(m.group(1))
+    return value / 1000.0 if m.group(2) == "ms" else value
+
+
+def _validate(step, fault, arg, rank) -> ChaosEntry:
+    if fault not in FAULTS:
+        raise ValueError(
+            f"unknown chaos fault {fault!r} (known: {sorted(FAULTS)})")
+    step = int(step)
+    if step < 0:
+        raise ValueError(f"chaos step must be >= 0, got {step}")
+    default = FAULTS[fault]
+    if arg is not None and default is None:
+        raise ValueError(f"chaos fault {fault!r} takes no argument, "
+                         f"got {arg!r}")
+    seconds = None
+    if default is not None:
+        seconds = parse_duration(arg) if arg is not None else float(default)
+        if seconds < 0:
+            raise ValueError(f"chaos duration must be >= 0, got {seconds}")
+    return ChaosEntry(step=step, fault=fault, arg=seconds,
+                      rank=int(rank if rank is not None else -1))
+
+
+def _parse_entry(token: str) -> ChaosEntry:
+    m = _ENTRY_RE.match(token.strip())
+    if not m:
+        raise ValueError(
+            f"bad chaos entry {token!r} (want "
+            f"'step=<N>:<fault>[=<arg>][@rank=<R>]')")
+    return _validate(m.group("step"), m.group("fault"), m.group("arg"),
+                     m.group("rank"))
+
+
+def _parse_json(path: str) -> List[ChaosEntry]:
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict):
+        data = data.get("schedule", data.get("entries"))
+    if not isinstance(data, list):
+        raise ValueError(
+            f"chaos JSON {path!r} must hold a list of entries (or a dict "
+            f"with a 'schedule' list)")
+    out = []
+    for i, item in enumerate(data):
+        if not isinstance(item, dict) or "step" not in item \
+                or "fault" not in item:
+            raise ValueError(
+                f"chaos JSON {path!r} entry {i} needs 'step' and 'fault' "
+                f"keys, got {item!r}")
+        out.append(_validate(item["step"], item["fault"], item.get("arg"),
+                             item.get("rank")))
+    return out
+
+
+def parse_schedule(spec: str,
+                   allowed: Optional[Sequence[str]] = None
+                   ) -> List[ChaosEntry]:
+    """Parse ``--chaos`` (inline grammar or a JSON file path) into entries,
+    sorted by step. ``allowed`` restricts the fault set for contexts that
+    support only part of it (serving passes :data:`SERVE_FAULTS`)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return []
+    if spec.startswith("@"):
+        entries = _parse_json(spec[1:])
+    elif os.path.isfile(spec):
+        entries = _parse_json(spec)
+    else:
+        entries = [_parse_entry(tok) for tok in spec.split(";")
+                   if tok.strip()]
+        if not entries:
+            raise ValueError(f"empty chaos schedule {spec!r}")
+    if allowed is not None:
+        bad = [e.fault for e in entries if e.fault not in allowed]
+        if bad:
+            raise ValueError(
+                f"chaos fault(s) {sorted(set(bad))} not supported in this "
+                f"context (allowed: {sorted(allowed)})")
+    return sorted(entries, key=lambda e: (e.step, e.fault))

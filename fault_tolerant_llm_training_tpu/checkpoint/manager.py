@@ -32,13 +32,25 @@ TPU-native design:
   first preemption.
 """
 
+import json
 import os
 import time
-from typing import Any, Optional, Tuple
+import zlib
+from typing import Any, Dict, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from ..obs import events
+from ..obs.registry import REGISTRY
+from ..utils.logging import (
+    AUDIT_CKPT_FALLBACK_FMT,
+    AUDIT_CKPT_PARTIAL_SKIPPED_FMT,
+    AUDIT_CKPT_VERIFY_FAILED_FMT,
+    logger,
+)
 from ..utils.sync import hard_sync
 
 # Fraction of raw filesystem write throughput the tuned Orbax pipeline
@@ -101,6 +113,127 @@ def estimate_save_seconds(state_bytes_per_host: int,
                                       * ORBAX_WRITE_EFFICIENCY, 1e-6)
 
 
+# ------------------------------------------------------ integrity manifests
+# Every finalized step directory gets an ``integrity.json`` mapping each
+# checkpoint file (relative path) to its size and CRC32. Orbax's zarr/ocdbt
+# layout stores each array's payload in its own file set under ``state/``,
+# so file-level checksums ARE per-array checksums keyed by the array's path.
+# The manifest is written AFTER Orbax's atomic commit (a finalized,
+# digit-named directory is complete by the rename contract), verified at
+# restore, and a failure falls back — audited — to the newest earlier step
+# that passes. A step without a manifest (written by an older build, or by
+# a job killed before its sweep) is accepted as legacy.
+
+MANIFEST_NAME = "integrity.json"
+
+_M_VERIFY_FAILURES = REGISTRY.counter(
+    "checkpoint_verify_failures_total",
+    "Checkpoint step directories that failed integrity verification at "
+    "restore")
+_M_LAST_SUCCESS_AGE = REGISTRY.gauge(
+    "checkpoint_last_success_age_seconds",
+    "Seconds since this process last finalized a checkpoint save or "
+    "completed a verified restore (staleness input for SLO alerts)")
+_last_success_t: Optional[float] = None
+
+
+def _mark_checkpoint_success() -> None:
+    global _last_success_t
+    _last_success_t = time.monotonic()
+    _M_LAST_SUCCESS_AGE.set(0.0)
+
+
+def update_checkpoint_age_gauge() -> None:
+    """Refresh ``checkpoint_last_success_age_seconds`` — called on the
+    training loop's logging cadence and per serve-loop iteration, so the
+    gauge ages between checkpoint events instead of freezing at 0."""
+    if _last_success_t is not None:
+        _M_LAST_SUCCESS_AGE.set(time.monotonic() - _last_success_t)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """No checkpoint step passed integrity verification."""
+
+
+def _crc32_file(path: str, chunk_bytes: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(chunk_bytes)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory fd so a just-renamed/just-written entry is durable
+    (a kill after rename but before the metadata flush could otherwise
+    resurface as a half-visible step on the next mount)."""
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename is still atomic
+    finally:
+        os.close(fd)
+
+
+def _manifest_files(step_dir: str) -> Dict[str, Dict[str, int]]:
+    files: Dict[str, Dict[str, int]] = {}
+    for root, _dirs, names in os.walk(step_dir):
+        for name in names:
+            if name == MANIFEST_NAME or name == MANIFEST_NAME + ".tmp":
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, step_dir)
+            files[rel] = {"size": os.path.getsize(path),
+                          "crc32": _crc32_file(path)}
+    return files
+
+
+def write_manifest(step_dir: str, step: int) -> None:
+    """Checksum every file of a FINALIZED step dir into integrity.json
+    (atomic tmp-rename write, fsync'd file and directory)."""
+    manifest = {"version": 1, "step": int(step),
+                "files": _manifest_files(step_dir)}
+    tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    _fsync_dir(step_dir)
+
+
+def verify_step_dir(step_dir: str) -> Tuple[bool, str]:
+    """Check a step dir against its manifest. Returns ``(ok, detail)``.
+    Missing manifest = legacy checkpoint, accepted. Extra files (e.g.
+    later-version metadata) are ignored — only manifest-listed files are
+    load-bearing for the restore."""
+    manifest_path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.isdir(step_dir):
+        return False, "step directory missing"
+    if not os.path.isfile(manifest_path):
+        return True, "no manifest (legacy checkpoint)"
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"unreadable manifest ({e})"
+    for rel, meta in sorted(manifest.get("files", {}).items()):
+        path = os.path.join(step_dir, rel)
+        if not os.path.isfile(path):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(path)
+        if size != meta["size"]:
+            return False, (f"size mismatch {rel} "
+                           f"({size} != {meta['size']})")
+        if _crc32_file(path) != meta["crc32"]:
+            return False, f"crc mismatch {rel}"
+    return True, "ok"
+
+
 def _pytree_handler_kwargs() -> dict:
     """zarr3 without compression (module docstring: 3x faster saves for ~8%
     more disk). ``use_compression`` only exists on newer orbax; older ones
@@ -135,6 +268,31 @@ class CheckpointManager:
                 "data": ocp.JsonCheckpointHandler(),
             })
         self.last_save_seconds: Optional[float] = None
+        self._partial_audited: set = set()
+
+    def _finalize_integrity(self) -> None:
+        """Post-commit sweep of the job's checkpoint root: write integrity
+        manifests for finalized step dirs that lack one, audit (once per
+        name) any leftover non-finalized temp dir, and fsync the root so
+        the just-renamed entries are durable. Orbax's commit protocol makes
+        a digit-named directory complete by construction — anything else
+        (``<step>.orbax-checkpoint-tmp-*`` style) is an interrupted write
+        the restore scan must never pick up."""
+        if not os.path.isdir(self.directory):
+            return
+        for name in sorted(os.listdir(self.directory)):
+            path = os.path.join(self.directory, name)
+            if not os.path.isdir(path):
+                continue
+            if name.isdigit():
+                if not os.path.isfile(os.path.join(path, MANIFEST_NAME)):
+                    write_manifest(path, int(name))
+            elif "tmp" in name and name not in self._partial_audited:
+                self._partial_audited.add(name)
+                events.emit_audit(
+                    logger, AUDIT_CKPT_PARTIAL_SKIPPED_FMT.format(name=name),
+                    "ckpt_partial_skipped", name=name)
+        _fsync_dir(self.directory)
 
     def save(self, step: int, state: Any, data_state: dict,
              wait: bool = False) -> int:
@@ -143,6 +301,20 @@ class CheckpointManager:
         records the wall time in ``last_save_seconds`` — the observed
         number the budget estimate exists to predict."""
         hard_sync(state)  # value-dependent barrier; see utils/sync.py
+        if not wait:
+            # The train step donates its state buffers (loop.py
+            # donate_argnums): once the loop dispatches the next step, the
+            # arrays this save captured are backed by buffers XLA is free
+            # to reuse. Orbax's async device-to-host copy can then read
+            # LATER steps' values — a torn checkpoint whose step dir name,
+            # data position, and per-array contents disagree (observed:
+            # dir 10 containing step-12 params beside step-10 loader
+            # state; found by scripts/chaos_campaign.py). Snapshot into
+            # fresh buffers (same sharding) so the async write has sole
+            # ownership. Fault-path saves block, so they skip the copy.
+            state = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                state)
         t0 = time.monotonic()
         self._mngr.save(
             step,
@@ -154,21 +326,76 @@ class CheckpointManager:
         if wait:
             self._mngr.wait_until_finished()
             self.last_save_seconds = time.monotonic() - t0
+            # The atomic-rename contract: a blocking save that returned
+            # must have produced the finalized digit-named directory. If
+            # Orbax's commit protocol ever regresses (or a filesystem
+            # lies), fail HERE, not at the eventual restore.
+            step_dir = os.path.join(self.directory, str(step))
+            assert os.path.isdir(step_dir), (
+                f"checkpoint step {step} reported saved but {step_dir} "
+                f"does not exist — atomic rename contract violated")
+            self._finalize_integrity()
+            _mark_checkpoint_success()
         return step
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def _verified_step(self, step: Optional[int]) -> int:
+        """Integrity gate for restore: scan candidate steps newest-first,
+        return the newest one whose directory passes its manifest. Every
+        rejected candidate is audited (``[CKPT VERIFY] ... failed``) and
+        counted; taking anything but the newest candidate is itself
+        audited (``[CKPT VERIFY] Falling back ...``) so the automatic
+        recovery is visible in the .out file and the flight recorder, not
+        silent. Raises :class:`CheckpointIntegrityError` if nothing
+        passes."""
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint steps in {self.directory}")
+        if step is None:
+            candidates = steps
+        else:
+            # An explicitly requested step still gets verified, and still
+            # falls back to older steps if corrupt — recovery beats
+            # precision when the alternative is a crash loop.
+            candidates = [s for s in steps if s <= step] or steps
+        chosen = None
+        for cand in candidates:
+            ok, detail = verify_step_dir(
+                os.path.join(self.directory, str(cand)))
+            if ok:
+                chosen = cand
+                break
+            _M_VERIFY_FAILURES.inc()
+            events.emit_audit(
+                logger,
+                AUDIT_CKPT_VERIFY_FAILED_FMT.format(step=cand, detail=detail),
+                "ckpt_verify_failed", step=int(cand), detail=detail,
+                ok=False)
+        if chosen is None:
+            raise CheckpointIntegrityError(
+                f"no checkpoint step in {self.directory} passed integrity "
+                f"verification (tried {candidates})")
+        if chosen != candidates[0]:
+            events.emit_audit(
+                logger, AUDIT_CKPT_FALLBACK_FMT.format(step=chosen),
+                "ckpt_fallback", step=int(chosen),
+                rejected=[int(s) for s in candidates
+                          if s > chosen])
+        return chosen
+
     def restore(self, abstract_state: Any,
                 step: Optional[int] = None) -> Tuple[Any, dict, int]:
-        """Restore (state, data_state, step). ``abstract_state`` is a
-        ShapeDtypeStruct pytree (with shardings) from ``jax.eval_shape`` —
-        params land directly as sharded device arrays on the current mesh,
-        the equivalent of the reference's cpu-load + load_state_dict
-        (train.py:22,56-58) without the host bounce."""
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint steps in {self.directory}")
+        """Restore (state, data_state, step) — the newest step that passes
+        integrity verification (see :meth:`_verified_step`; a corrupt
+        newest checkpoint falls back, audited, to the previous passing
+        one). ``abstract_state`` is a ShapeDtypeStruct pytree (with
+        shardings) from ``jax.eval_shape`` — params land directly as
+        sharded device arrays on the current mesh, the equivalent of the
+        reference's cpu-load + load_state_dict (train.py:22,56-58) without
+        the host bounce."""
+        step = self._verified_step(step)
         restored = self._mngr.restore(
             step,
             args=ocp.args.Composite(
@@ -183,10 +410,15 @@ class CheckpointManager:
                 data=ocp.args.JsonRestore(),
             ),
         )
+        _mark_checkpoint_success()
         return restored["state"], restored["data"], step
 
     def wait_until_finished(self) -> None:
         self._mngr.wait_until_finished()
+        self._finalize_integrity()
+        _mark_checkpoint_success()
 
     def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._finalize_integrity()
         self._mngr.close()

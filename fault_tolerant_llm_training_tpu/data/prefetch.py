@@ -36,10 +36,17 @@ class DevicePrefetcher:
     """
 
     def __init__(self, loader, sharding=None, depth: int = 2,
-                 stage_in_worker: Optional[bool] = None):
+                 stage_in_worker: Optional[bool] = None,
+                 chaos_on_batch=None, start_batch: int = 0):
         self.loader = loader
         self.sharding = sharding
         self.depth = max(1, depth)
+        # Chaos hook (chaos/injector.py on_batch): called in the worker
+        # with the global step the produced batch will feed, BEFORE it is
+        # queued — a loader_stall delays exactly that batch's delivery.
+        # start_batch is the resume step so schedule steps stay global.
+        self._chaos_on_batch = chaos_on_batch
+        self._batch_index = start_batch
         if stage_in_worker is None:
             stage_in_worker = jax.process_count() == 1
         self.stage_in_worker = stage_in_worker
@@ -72,6 +79,9 @@ class DevicePrefetcher:
                 state = self.loader.get_state()
                 if self.stage_in_worker:
                     inputs, labels = self._stage_pair(inputs, labels)
+                if self._chaos_on_batch is not None:
+                    self._chaos_on_batch(self._batch_index)
+                self._batch_index += 1
                 self._q.put((inputs, labels, state))
         except BaseException as e:  # surfaced to the consumer
             self._exc = e

@@ -139,6 +139,13 @@ def _handle_exit(trainer, error_type: int, logger) -> None:
             events.emit_audit(logger, AUDIT_SAVED_FMT.format(step=saved_step),
                               "exit", step=saved_step, error_type=error_type,
                               cls=cls, saved=True, saved_step=saved_step)
+            # Armed ckpt_corrupt faults corrupt the checkpoint AFTER its
+            # commit + integrity manifest (chaos/injector.py) — the next
+            # job's restore must catch it and fall back.
+            chaos = getattr(trainer, "chaos", None)
+            if chaos is not None and saved_step is not None:
+                chaos.post_fault_save(trainer.ckpt_mngr.directory,
+                                      saved_step, logger)
         else:
             logger.info("[EXIT HANDLER] No training state to save yet.")
             events.emit(kind="exit", error_type=error_type, cls=cls,

@@ -19,10 +19,20 @@ code errors use -1.
 """
 
 import contextlib
+import os
 import signal
 from typing import Optional
 
 _FAULT_SIGNALS = {signal.SIGUSR1, signal.SIGTERM}
+
+
+def inject(signum: int) -> None:
+    """Deliver a real POSIX signal to this process (the chaos injection
+    path, chaos/injector.py). Routing through ``os.kill`` — not a direct
+    flag mutation — means the installed handler, the first-signal-wins
+    latch, ``deferred()`` masking and the multihost agreement all run
+    exactly as they would for a scheduler-sent signal."""
+    os.kill(os.getpid(), signum)
 
 
 class TrainingSignal(Exception):

@@ -17,6 +17,8 @@ import argparse
 import os
 import sys
 
+from ..chaos import SERVE_FAULTS, ChaosInjector, parse_schedule
+from ..checkpoint.manager import update_checkpoint_age_gauge
 from ..data.tokenizer import load_tokenizer
 from ..ft.signals import SignalFlag
 from ..models.configs import get_config
@@ -141,6 +143,11 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "(0 = disabled); TTFT, decode-step, slot occupancy")
     p.add_argument("--event-log", default="",
                    help="flight-recorder JSONL path ('' = disabled)")
+    p.add_argument("--chaos", default="",
+                   help="fault schedule keyed by decode iteration "
+                        "('step=<N>:sigusr1' / 'step=<N>:sigterm'; "
+                        "chaos/schedule.py grammar) — delivers a real "
+                        "drain signal mid-decode")
     return p.parse_args(argv)
 
 
@@ -149,6 +156,15 @@ def main(argv=None) -> None:
     init_logger()
     flag = SignalFlag()
     flag.register()  # before engine build, like train.py
+    # Chaos (chaos/): serving supports only the signal faults — a drain
+    # delivered mid-decode. Parse errors (or non-serve faults) fail fast,
+    # before the expensive engine build.
+    chaos = None
+    if args.chaos:
+        chaos = ChaosInjector(
+            parse_schedule(args.chaos, allowed=SERVE_FAULTS),
+            seed=args.seed)
+        logger.info(f"Chaos schedule | {chaos.describe()}")
     if args.event_log:
         events.configure(args.event_log, job=JOBID or "serve",
                          host=os.getpid())
@@ -228,6 +244,11 @@ def main(argv=None) -> None:
 
     drained = False
     while sched.pending():
+        if chaos is not None:
+            # keyed by decode iteration: the signal lands here and the
+            # flag check just below begins the drain lifecycle mid-decode
+            chaos.on_serve_step(sched.iterations)
+        update_checkpoint_age_gauge()
         # not admission_open: a chunked prefill may have seen the signal
         # first (scheduler stop_check) and closed admission itself — the
         # audit trail must still record the drain exactly once.

@@ -44,6 +44,15 @@ from typing import Callable, Dict, List, Optional
 #   requeue       sbatch resubmission attempt (payload: ok)
 #   exit          exit-handler verdict (payload: error_type, class, saved)
 #   complete      AUDIT_COMPLETED / AUDIT_SERVE_COMPLETED
+#   chaos_<fault> chaos injection fired (chaos/injector.py; one kind per
+#                 fault class, e.g. chaos_sigusr1, chaos_ckpt_corrupt —
+#                 the latter twice: phase=raise then phase=corrupted)
+#   ckpt_verify_failed   a step dir failed its integrity manifest at
+#                        restore (payload: step, detail)
+#   ckpt_fallback        restore fell back to an older passing step
+#                        (payload: step chosen, rejected steps)
+#   ckpt_partial_skipped leftover non-finalized tmp dir seen (and never
+#                        restored) during the finalize sweep
 
 
 class FlightRecorder:

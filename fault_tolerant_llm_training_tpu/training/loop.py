@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding
 
-from ..checkpoint.manager import CheckpointManager
+from ..chaos.injector import ChaosInjector
+from ..checkpoint.manager import CheckpointManager, update_checkpoint_age_gauge
 from ..data.collator import CollatorForCLM
 from ..data.loader import DataLoader
 from ..data.parquet import IterableParquetDataset, ParquetDataset
@@ -144,6 +145,12 @@ class Trainer:
         events.configure(cfg.event_log_path(self._job_id),
                          job=self._job_id, host=jax.process_index())
         self._init_metrics()
+
+        # Chaos injectors (chaos/): the parsed --chaos schedule plus the
+        # legacy --raise-error alias, seeded by --seed. None = no chaos.
+        self.chaos = ChaosInjector.from_config(cfg)
+        if self.chaos is not None:
+            logger.info(f"Chaos schedule | {self.chaos.describe()}")
 
         self.mesh = make_mesh(cfg.dp, cfg.fsdp, cfg.sp, cfg.tp, pp=cfg.pp,
                               ep=cfg.ep)
@@ -385,9 +392,10 @@ class Trainer:
                                    cache=("on" if cache_on else "off"))
         logger.info(f"Train step compiled in {compile_secs:.2f}s "
                     f"(cache {'on' if cache_on else 'off'})")
-        self.prefetcher = DevicePrefetcher(self.loader,
-                                           sharding=self.batch_sharding,
-                                           depth=cfg.prefetch)
+        self.prefetcher = DevicePrefetcher(
+            self.loader, sharding=self.batch_sharding, depth=cfg.prefetch,
+            chaos_on_batch=(self.chaos.on_batch if self.chaos else None),
+            start_batch=self.training_step)
         self.throughput = Throughput(
             tokens_per_step=cfg.batch_size * cfg.sequence_length)
         if self._resumed:
@@ -623,6 +631,11 @@ class Trainer:
         sync_freq = max(1, cfg.signal_sync_frequency)
         first_iteration = True
         while self.training_step < cfg.training_steps:
+            if self.chaos is not None:
+                # Sync-boundary faults (kv_delay / kv_fail) fire BEFORE the
+                # real agreement round below, modeling a slow or failed
+                # KV-store round at the exact point one would hurt.
+                self.chaos.on_sync_boundary(self, self.training_step)
             if self._sync_signals:
                 # Host-side non-blocking poll FIRST: a peer's announced
                 # local fault must stop this host before it dispatches
@@ -679,20 +692,14 @@ class Trainer:
             self._inflight.append((self.training_step, metrics["packed"]))
             while len(self._inflight) >= max(1, cfg.inflight):
                 self._consume(*self._inflight.popleft())
-            # Deterministic fault injection (ref: train.py:112-113): raised
-            # while the counter still equals error_step, after the update.
-            # --error-local-rank N restricts the raise to one process —
-            # the host-LOCAL (non-replicated) fault shape that exercises
-            # the pod fence; it does not drain, like a real local fault.
-            if cfg.raise_error and self.training_step == cfg.error_step:
-                if cfg.error_local_rank < 0:
-                    self._drain_inflight()
-                    self.error_is_replicated = True
-                    raise Exception(
-                        "Simulated exception to test signal handler", -1)
-                if cfg.error_local_rank == jax.process_index():
-                    raise Exception(
-                        "Simulated exception to test signal handler", -1)
+            # Deterministic fault injection (ref: train.py:112-113): the
+            # single training-loop injection site, fired while the counter
+            # still equals the entry's step, after the update. The legacy
+            # --raise-error flag is an alias for one 'exception' entry
+            # (chaos/injector.py from_config); signal, exception and
+            # checkpoint-corruption faults all originate here.
+            if self.chaos is not None:
+                self.chaos.on_train_step(self, self.training_step)
             if self._trace is not None:
                 self._trace.on_step_end(self.training_step)
             self.training_step += 1
@@ -863,6 +870,9 @@ class Trainer:
                 tokens=steps_in_window * self.throughput.tokens_per_step,
                 loss=loss, grad_norm=grad_norm)
             self._step_window_start = (now_wall, step_no)
+            # Staleness gauge ages on the logging cadence; save/restore
+            # reset it to 0 (checkpoint/manager.py).
+            update_checkpoint_age_gauge()
             tps = self.throughput.tokens_per_sec
             if tps:
                 window = self.throughput.window_tag or "steady"

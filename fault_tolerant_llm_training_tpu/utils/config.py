@@ -38,8 +38,11 @@ class TrainConfig:
     grad_max_norm: float = 1.0
     model_dtype: str = "bf16"
     compile: bool = False  # no-op on TPU: the train step is always jitted
-    raise_error: bool = False
+    raise_error: bool = False  # legacy alias for --chaos "step=N:exception"
     error_step: int = 100
+    # Declarative fault schedule (chaos/schedule.py grammar or a JSON file):
+    # "step=<N>:<fault>[=<arg>][@rank=<R>];..." — seeded by --seed.
+    chaos: str = ""
     # Restrict --raise-error to one process index (a host-LOCAL fault, the
     # pod fence's test shape); -1 = raise on every process (replicated,
     # the reference's single-process semantics).
@@ -196,7 +199,16 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
         help="Accepted for CLI parity; the train step is always jitted on TPU",
     )
     parser.add_argument("--raise-error", action="store_true",
-                        help="Raise an error in the training loop at --error-step")
+                        help="Raise an error in the training loop at "
+                             "--error-step (legacy alias for --chaos "
+                             "'step=N:exception')")
+    parser.add_argument("--chaos", type=str, default="",
+                        help="Declarative fault schedule: "
+                             "'step=<N>:<fault>[=<arg>][@rank=<R>]' entries "
+                             "separated by ';' (faults: sigusr1, sigterm, "
+                             "exception, ckpt_corrupt, loader_stall, "
+                             "kv_delay, kv_fail), or a JSON schedule file "
+                             "path. Injections are seeded by --seed.")
     parser.add_argument("--error-step", type=int, default=100,
                         help="Step at which to raise an error if --raise-error is set")
     parser.add_argument("--error-local-rank", type=int, default=-1,

@@ -69,3 +69,15 @@ AUDIT_REQUEST_DONE_FMT = ("Request {id} done | {reason} | prompt "
                           "{prompt_tokens} tok | generated {new_tokens} tok "
                           "| ttft {ttft_ms:.0f} ms | {tps:.1f} tok/s")
 AUDIT_SERVE_COMPLETED = "Serving completed"
+
+# --- Chaos + checkpoint-integrity audit trail (chaos/injector.py,
+# checkpoint/manager.py) — same contract: these strings are what
+# scripts/chaos_campaign.py and tests/test_chaos.py grep for, frozen in
+# tests/test_audit_contract.py like the rest. ---
+AUDIT_CHAOS_INJECT_FMT = "[CHAOS] Injected {fault} at step {step}"
+AUDIT_CKPT_VERIFY_FAILED_FMT = ("[CKPT VERIFY] Checkpoint step {step} "
+                                "failed integrity check: {detail}")
+AUDIT_CKPT_FALLBACK_FMT = ("[CKPT VERIFY] Falling back to checkpoint step "
+                           "{step} (newest passing)")
+AUDIT_CKPT_PARTIAL_SKIPPED_FMT = ("[CKPT FINALIZE] Skipped partial "
+                                  "checkpoint directory {name}")

@@ -81,6 +81,10 @@ def pytest_configure(config):
         "markers",
         "slow: multi-second CPU tests (multi-round speculative streams, "
         "big layout matrices); tier-1 runs -m 'not slow'")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection scenarios (tests/test_chaos.py); the heavy "
+        "end-to-end ones are also slow-marked")
 
 
 _MP_PROBE_WORKER = """

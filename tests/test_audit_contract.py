@@ -65,6 +65,15 @@ FROZEN = {
         "generated {new_tokens} tok | ttft {ttft_ms:.0f} ms | "
         "{tps:.1f} tok/s",
     "AUDIT_SERVE_COMPLETED": "Serving completed",
+    "AUDIT_CHAOS_INJECT_FMT": "[CHAOS] Injected {fault} at step {step}",
+    "AUDIT_CKPT_VERIFY_FAILED_FMT":
+        "[CKPT VERIFY] Checkpoint step {step} failed integrity check: "
+        "{detail}",
+    "AUDIT_CKPT_FALLBACK_FMT":
+        "[CKPT VERIFY] Falling back to checkpoint step {step} "
+        "(newest passing)",
+    "AUDIT_CKPT_PARTIAL_SKIPPED_FMT":
+        "[CKPT FINALIZE] Skipped partial checkpoint directory {name}",
 }
 
 
