@@ -16,6 +16,13 @@ equivalence testing), and two families of compiled programs —
   (Sarathi-Serve's chunked prefill), so the bucket set caps COMPILE COUNT,
   not prompt length — any prompt up to ``max_len`` is served, and the host
   loop can be interrupted cleanly between chunks for the drain lifecycle.
+  The chunk loop runs every chunk at an explicit absolute offset, which is
+  also what makes PREFIX-CACHE hits cheap: ``prefill(start_pos=k)`` simply
+  starts the loop at k, attending to the shared blocks' committed KV
+  through the block row without recomputing them
+  (inference/prefix_cache.py; ``enable_prefix_cache``). The one device op
+  sharing needs — copy-on-write before resuming inside a shared block —
+  is its own tiny AOT program (``cow_copy``), donated like the rest.
 - **decode**: one token for ALL slots at once (B=slots, S=1, per-slot
   offsets = cache lengths). The cache is donated (``donate_argnums``), so
   XLA aliases the pools/ring buffers in place; the paged layout additionally
@@ -85,6 +92,7 @@ from .kv_cache import (
     PagedKVCache,
     blocks_per_slot,
     cache_shardings,
+    copy_kv_block,
     init_cache,
     init_paged_cache,
 )
@@ -138,7 +146,8 @@ class InferenceEngine:
                  draft_cfg: Optional[TransformerConfig] = None,
                  draft_params=None, spec_k: int = 0,
                  draft_num_blocks: Optional[int] = None,
-                 spec_verify_impl: str = "exact"):
+                 spec_verify_impl: str = "exact",
+                 prefix_cache: bool = True):
         if kv_layout not in ("paged", "ring"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
         if cfg.layer_impl == "scan":
@@ -165,6 +174,10 @@ class InferenceEngine:
                                                        kv_block_size)
             self.num_blocks = (kv_num_blocks
                                or slots * self.max_blocks_per_slot + 1)
+        # Content-addressed prefix reuse (inference/prefix_cache.py): the
+        # scheduler builds the radix tree only for engines that advertise
+        # it. Paged-only — sharing is a property of the block indirection.
+        self.enable_prefix_cache = bool(prefix_cache) and kv_layout == "paged"
         self.model = Transformer(cfg)
 
         # --- speculative decoding: second model lifecycle ------------------
@@ -329,6 +342,18 @@ class InferenceEngine:
         lengths = cache.lengths + active.astype(jnp.int32)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), toks
 
+    def _cow_fn(self, cache, src, dst):
+        """Copy-on-write: duplicate pool block ``src`` into ``dst`` across
+        every layer's K and V pools (kv_cache.py ``copy_kv_block``). Run
+        once at admission when a full-prompt prefix-cache hit must resume
+        prefill inside its final shared block — the copy is bitwise, so
+        the resumed stream stays bit-identical to an uncached run. The
+        cache is donated: XLA rewrites one block row per pool in place."""
+        return PagedKVCache(
+            k=tuple(copy_kv_block(p, src, dst) for p in cache.k),
+            v=tuple(copy_kv_block(p, src, dst) for p in cache.v),
+            lengths=cache.lengths)
+
     def _draft_k_fn(self, params, cache, block_tables, tokens, offsets,
                     active, temperature, top_p, seeds, rounds):
         """All k chained draft micro-steps in ONE compiled program.
@@ -469,6 +494,9 @@ class InferenceEngine:
                 self._paged_decode_fn, donate_argnums=(1,)).lower(
                 p_abs, c_abs, tables_abs, slots_i, slots_b, slots_f,
                 slots_f, slots_i, slots_i).compile()
+            self._cow = jax.jit(
+                self._cow_fn, donate_argnums=(0,)).lower(
+                c_abs, scalar_i, scalar_i).compile()
             for b in self.prefill_buckets:
                 tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
                 self._prefill[b] = jax.jit(
@@ -516,14 +544,28 @@ class InferenceEngine:
 
     # --- host API ----------------------------------------------------------
 
+    def cow_copy(self, src_block: int, dst_block: int) -> None:
+        """Copy-on-write one pool block: ``src_block``'s K/V (all layers)
+        into ``dst_block``. The scheduler calls this before remapping a
+        slot's table away from a shared block it must write into (prefix
+        cache, full-prompt hit); the shared original is never written."""
+        if self.kv_layout != "paged":
+            raise ValueError("copy-on-write requires the paged KV layout")
+        self.cache = self._cow(self.cache, np.int32(src_block),
+                               np.int32(dst_block))
+
     def _stream_chunks(self, draft: bool, row, ids, slot, temperature,
-                       top_p, seed, stop_check, on_chunk):
+                       top_p, seed, stop_check, on_chunk, start_pos=0):
         """Stream ``ids`` through the paged prefill bucket programs of the
-        target (or, spec mode, the draft) model; returns the final chunk's
-        sampled token, or None if ``stop_check`` fired between chunks."""
+        target (or, spec mode, the draft) model, beginning at absolute
+        position ``start_pos`` (0 = full prompt; a prefix-cache hit resumes
+        at its first uncached position — the chunk loop already runs every
+        chunk at an explicit offset, so resumption is just a nonzero start);
+        returns the final chunk's sampled token, or None if ``stop_check``
+        fired between chunks."""
         n = ids.size
         chunk = self.prefill_buckets[-1]
-        start, tok = 0, None
+        start, tok = int(start_pos), None
         while start < n:
             m = min(chunk, n - start)
             bucket = next(b for b in self.prefill_buckets if b >= m)
@@ -549,8 +591,8 @@ class InferenceEngine:
                 draft_block_row=None, temperature: float = 0.0,
                 top_p: float = 1.0, seed: int = 0,
                 stop_check: Optional[Callable[[], bool]] = None,
-                on_chunk: Optional[Callable[[], None]] = None
-                ) -> Optional[int]:
+                on_chunk: Optional[Callable[[], None]] = None,
+                start_pos: int = 0) -> Optional[int]:
         """Prompt into ``slot``; returns the first generated token id.
 
         Ring layout: the prompt must fit the largest bucket (one shot).
@@ -563,16 +605,28 @@ class InferenceEngine:
         and returns None (caller frees the blocks and reports the request
         unserved: the drain-lifecycle contract for mid-prompt signals).
 
+        ``start_pos`` (paged only) resumes the prompt at an absolute
+        position: positions [0, start_pos) are NOT computed — the block
+        row's leading entries must already hold their committed KV
+        (prefix-cache hit blocks). The resumed chunks attend to those
+        positions through the same block tables, and the chunk programs
+        are the identical AOT bucket set a zero-offset prefill uses, so a
+        cache-hit stream is bitwise the uncached stream.
+
         Spec mode additionally prefills the DRAFT cache through
         ``draft_block_row`` (its own pool's allocation) after the target
         phase — same chunking, same ``stop_check`` at every chunk boundary
         including the phase boundary, so a mid-prompt drain still frees
         BOTH pools and reports the request unserved. The draft phase's
         sampled token is discarded (the target's first token is the one
-        emitted; the draft proposes only from round 1 on).
+        emitted; the draft proposes only from round 1 on). The draft phase
+        always streams the FULL prompt regardless of ``start_pos``: the
+        draft pool opts out of prefix caching (scheduler docstring).
         """
         ids = np.asarray(token_ids, np.int32).reshape(-1)
         n = ids.size
+        if start_pos and self.kv_layout != "paged":
+            raise ValueError("start_pos requires the paged KV layout")
         if self.kv_layout != "paged":
             if not 0 < n <= self.prefill_buckets[-1]:
                 raise ValueError(f"prompt length {n} outside "
@@ -594,8 +648,11 @@ class InferenceEngine:
                              f"expected {self.max_blocks_per_slot}")
         if self.spec_k and draft_block_row is None:
             raise ValueError("spec-mode prefill requires draft_block_row")
+        if not 0 <= start_pos < n:
+            raise ValueError(f"start_pos {start_pos} outside [0, {n})")
         tok = self._stream_chunks(False, row, ids, slot, temperature, top_p,
-                                  seed, stop_check, on_chunk)
+                                  seed, stop_check, on_chunk,
+                                  start_pos=start_pos)
         if tok is None:
             return None
         if self.spec_k:
@@ -675,7 +732,10 @@ class InferenceEngine:
         return np.asarray(out), np.asarray(acc)
 
     def reset(self) -> None:
-        """Zero all slot lengths (the buffers' stale contents are masked)."""
+        """Zero all slot lengths (the buffers' stale contents are masked).
+        Any prefix cache built over the old pool contents dies with them —
+        a scheduler is per-stream, so resetting the engine and building a
+        fresh ``Scheduler`` (fresh radix tree) is the supported pattern."""
         with use_mesh(self.mesh):
             cache = self._init_cache(dtype=self.cache.k[0].dtype)
             cs = cache_shardings(cache, self.mesh)

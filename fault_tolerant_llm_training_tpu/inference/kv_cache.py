@@ -24,6 +24,15 @@ blocks. The block allocator lives host-side in the scheduler
 (inference/scheduler.py ``BlockAllocator``); the device only ever sees the
 pool and the tables.
 
+Because the tables are plain indices, a pool block can appear in SEVERAL
+slots' tables at once — that is the prefix cache
+(inference/prefix_cache.py): requests sharing a committed prompt prefix
+point their tables at the same blocks and skip the prefill compute for
+them. Sharing is refcounted in the allocator and strictly READ-only: the
+only write a shared block ever sees is :func:`copy_kv_block` — the
+copy-on-write primitive that duplicates it into a private block before a
+slot resumes prefill inside it.
+
 Everything is a fixed-shape pytree argument (flax ``struct``), NOT a flax
 mutable collection: the jitted decode step takes the cache in and returns it
 out, which lets the engine donate the buffers (jax.jit ``donate_argnums``)
@@ -153,6 +162,18 @@ def write_paged_kv(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
     off = pos % bs
     upd = jnp.transpose(new, (0, 2, 1, 3)).reshape(b * s, k, d)
     return pool.at[blk.reshape(-1), :, off.reshape(-1), :].set(upd)
+
+
+def copy_kv_block(pool: jax.Array, src: jax.Array, dst: jax.Array
+                  ) -> jax.Array:
+    """Copy one pool block's (kv_heads, block_size, head_dim) contents from
+    row ``src`` to row ``dst`` — the copy-on-write primitive. A slot about
+    to write INSIDE a block it shares with other requests (prefix-cache
+    full-prompt hit resuming at the last prompt position) first duplicates
+    the block into a private one and remaps its table entry; the shared
+    original is never written. Bitwise copy of committed bytes, so the
+    divergent stream stays bit-identical to an uncached run."""
+    return pool.at[dst].set(pool[src])
 
 
 def write_slot_kv(buf: jax.Array, new: jax.Array,

@@ -17,6 +17,25 @@ for the next admission. A request whose blocks aren't available yet simply
 waits at the head of the queue (FIFO, no starvation) — exhaustion queues,
 it never crashes.
 
+With the engine's prefix cache enabled (``enable_prefix_cache``, the paged
+default) admission first walks the content-addressed radix tree
+(inference/prefix_cache.py): blocks covering a cached prompt prefix are
+attached to the slot's table at ZERO allocation cost (a refcount each) and
+prefill resumes at the first divergent block through the existing chunked
+path — a fully-shared prompt skips all but its last position. A full-prompt
+hit still needs that last position's logits, so the final shared block is
+COPY-ON-WRITE duplicated (engine.cow_copy) into a private block before
+prefill resumes inside it; shared blocks are never written. Under pool
+pressure, admission evicts LRU cached prefixes no live slot references
+before making the head of the queue wait. The DRAFT pool (speculative
+mode) opts OUT of prefix caching by design: draft prefill is a tiny
+fraction of target prefill (that is what makes the draft a draft), while
+participating would cost a second radix tree, a second COW program family,
+and draft-pool admission coupling — all to skip compute the bench can't
+see. Draft admission stays full-footprint; decode/spec rounds only ever
+write at positions >= prompt_len, which live in the slot's private blocks,
+so sharing never constrains them.
+
 When the engine was built with a draft model (``spec_k > 0``) the
 scheduler runs SPECULATIVE rounds instead of single-token decode
 iterations: each round emits 1..k+1 tokens per slot (engine.py
@@ -38,33 +57,44 @@ reports it unserved — the drain stays exact even for long prompts.
 """
 
 import dataclasses
+import logging
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import events
 from ..obs.registry import (
     SPEC_TOKEN_BUCKETS,
     MetricRegistry,
     default_registry,
 )
+from ..utils.logging import AUDIT_KV_LEAK_FMT
+from .prefix_cache import PrefixCache
+
+logger = logging.getLogger()
 
 
 class BlockAllocator:
-    """Host-side free list over the paged cache's block pool.
+    """Host-side REFCOUNTED free list over the paged cache's block pool.
 
     Block 0 is the reserved null/scratch block (inference/kv_cache.py):
     free block-table entries point at it and masked writes divert into it,
-    so it is never handed out. ``free()`` refuses double-frees — an
-    allocator bug corrupting two requests' caches should fail loudly, not
-    silently cross-wire their KV.
+    so it is never handed out. Blocks are born at refcount 1 (``alloc``);
+    prefix sharing takes extra references (``incref``: the cache's own hold
+    on an inserted block, and each additional slot admitted onto a cached
+    prefix — inference/prefix_cache.py documents the full ownership
+    protocol). ``free()`` DECREMENTS; a block returns to the free list only
+    when its last holder drops it. Releasing a block that has no live
+    reference still raises — an allocator bug corrupting two requests'
+    caches should fail loudly, not silently cross-wire their KV.
     """
 
     def __init__(self, num_blocks: int):
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, 0, -1))  # LIFO: reuse warm
-        self._used: set = set()
+        self._ref: Dict[int, int] = {}  # block -> live reference count
 
     @property
     def capacity(self) -> int:
@@ -76,24 +106,44 @@ class BlockAllocator:
 
     @property
     def used_count(self) -> int:
-        return len(self._used)
+        return len(self._ref)
+
+    @property
+    def shared_count(self) -> int:
+        """Blocks with more than one live reference (prefix sharing)."""
+        return sum(1 for c in self._ref.values() if c > 1)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n blocks, or None if fewer than n are free (caller queues)."""
+        """n blocks at refcount 1, or None if fewer than n are free
+        (caller queues or evicts cached prefixes)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
-        self._used.update(blocks)
+        for b in blocks:
+            self._ref[b] = 1
         return blocks
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def incref(self, blocks: Sequence[int]) -> None:
+        """One extra reference per block (must be live)."""
         for b in blocks:
-            if b not in self._used:
+            if b not in self._ref:
+                raise ValueError(f"incref of unallocated block {b}")
+            self._ref[b] += 1
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; the last drop frees the block."""
+        for b in blocks:
+            if b not in self._ref:
                 raise ValueError(f"double free of block {b}")
-            self._used.remove(b)
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
 
 
 @dataclasses.dataclass
@@ -239,6 +289,30 @@ class Scheduler:
             "Tokens banked per verify round (accepted prefix + bonus, "
             "after EOS/budget truncation)",
             buckets=SPEC_TOKEN_BUCKETS)
+        self._m_prefix_hit_rate = r.gauge(
+            "kv_prefix_hit_rate",
+            "Prompt tokens served from the prefix cache / prompt tokens "
+            "admitted (0-1, running)")
+        self._m_blocks_shared = r.gauge(
+            "kv_blocks_shared",
+            "KV pool blocks with more than one live reference "
+            "(prefix sharing)")
+        self._m_prefix_evictions = r.counter(
+            "prefix_evictions_total",
+            "Cached prefix blocks evicted under pool pressure (LRU, "
+            "refcount-0 only)")
+        # Content-addressed prefix reuse: only engines that OPT IN get the
+        # cache (InferenceEngine sets enable_prefix_cache in paged mode;
+        # test doubles without the attribute keep plain allocation).
+        self.prefix_cache: Optional[PrefixCache] = None
+        self.prefix_cow_copies = 0
+        self.prefill_seconds = 0.0
+        self._leak_audited = False
+        if (self.kv_layout == "paged"
+                and getattr(engine, "enable_prefix_cache", False)):
+            self.prefix_cache = PrefixCache(
+                self.allocator, engine.block_size,
+                evictions_counter=self._m_prefix_evictions)
         if self.kv_layout == "paged":
             self._m_blocks_free.set(self.allocator.free_count)
 
@@ -318,27 +392,76 @@ class Scheduler:
         while free and self.queue:
             req, submitted_at = self.queue[0]
             blocks, dblocks = None, None
+            hit = None
             if self.kv_layout == "paged":
                 # admission is by free-BLOCK count, not free-slot count:
                 # the head of the queue waits (FIFO, no starvation) until
-                # eviction frees enough blocks for its actual need. Spec
+                # eviction frees enough blocks for its actual need. A
+                # prefix-cache hit covers its blocks at zero cost (one
+                # refcount each); only the remainder is allocated fresh —
+                # plus one COW block when the hit covers the whole prompt
+                # (prefill must resume inside the final shared block). On
+                # shortage, LRU cached prefixes no live slot references
+                # are evicted before the head of the queue waits. Spec
                 # mode admits by the COMBINED footprint — both pools must
                 # cover the request, and a partial grab is rolled back so
                 # a draft-pool shortage can't strand target blocks.
-                blocks = self.allocator.alloc(self._blocks_needed(req))
+                total = self._blocks_needed(req)
+                if self.prefix_cache is not None:
+                    hit = self.prefix_cache.match(req.prompt)
+                    if not hit.blocks:
+                        hit = None
+                fresh = total - (len(hit.blocks) if hit else 0) \
+                    + (1 if hit and hit.full else 0)
+                if hit is not None:
+                    # reference the hit FIRST: the eviction below can then
+                    # never free the prefix this slot is about to reuse
+                    self.prefix_cache.acquire(hit)
+                blocks = self.allocator.alloc(fresh)
+                if blocks is None and self.prefix_cache is not None:
+                    if self.prefix_cache.evict(
+                            fresh - self.allocator.free_count):
+                        blocks = self.allocator.alloc(fresh)
                 if blocks is None:
+                    if hit is not None:
+                        self.allocator.free(hit.blocks)
                     break
                 if self.spec_k:
-                    dblocks = self.draft_allocator.alloc(
-                        self._blocks_needed(req))
+                    # draft pool opts OUT of prefix caching (module
+                    # docstring): full footprint, rollback on shortage
+                    dblocks = self.draft_allocator.alloc(total)
                     if dblocks is None:
                         self.allocator.free(blocks)
+                        if hit is not None:
+                            self.allocator.free(hit.blocks)
                         break
             self.queue.popleft()
             slot = free.pop(0)
             if self.kv_layout == "paged":
+                start_pos = 0
+                slot_blocks = blocks
+                if hit is not None:
+                    slot_blocks = list(hit.blocks)
+                    start_pos = hit.tokens
+                    fresh_tail = blocks
+                    if hit.full:
+                        # Full-prompt hit: sampling the first token needs
+                        # the LAST prompt position's logits, so prefill
+                        # resumes at prompt_len - 1 — a write into the
+                        # final shared block. Copy-on-write: duplicate it
+                        # into the first fresh block, remap, and drop this
+                        # slot's reference on the shared original.
+                        cow_dst = blocks[0]
+                        self.engine.cow_copy(slot_blocks[-1], cow_dst)
+                        self.allocator.free([slot_blocks[-1]])
+                        slot_blocks[-1] = cow_dst
+                        start_pos = hit.tokens - 1
+                        fresh_tail = blocks[1:]
+                        self.prefix_cache.cow_copies += 1
+                        self.prefix_cow_copies += 1
+                    slot_blocks = slot_blocks + fresh_tail
                 row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
-                row[:len(blocks)] = blocks
+                row[:len(slot_blocks)] = slot_blocks
                 self.block_tables[slot] = row
                 spec_kw = {}
                 if self.spec_k:
@@ -349,18 +472,26 @@ class Scheduler:
                     # only spec-mode engines need (or accept) the draft
                     # row — non-spec engine doubles keep the old signature
                     spec_kw["draft_block_row"] = drow
+                if self.prefix_cache is not None:
+                    # only cache-aware engines accept the offset kwarg —
+                    # test doubles without enable_prefix_cache never see it
+                    spec_kw["start_pos"] = start_pos
+                t0 = self.clock()
                 first = self.engine.prefill(
                     slot, req.prompt, block_row=row,
                     temperature=req.temperature, top_p=req.top_p,
                     seed=req.seed, stop_check=self._drain_requested,
                     on_chunk=self._count_chunk, **spec_kw)
+                self.prefill_seconds += self.clock() - t0
                 if first is None:
                     # Drain fired mid-prompt: the engine finished the
-                    # current chunk and stopped. Free the blocks (both
-                    # pools in spec mode), put the request back at the head
-                    # so it is REPORTED unserved, and close admission —
-                    # the drain stays exact.
-                    self.allocator.free(blocks)
+                    # current chunk and stopped. Free the slot's blocks
+                    # exactly once each (fresh, COW and acquired shared
+                    # references alike — shared blocks survive under the
+                    # cache's own reference), put the request back at the
+                    # head so it is REPORTED unserved, and close
+                    # admission — the drain stays exact.
+                    self.allocator.free(slot_blocks)
                     self.block_tables[slot] = 0
                     if self.spec_k:
                         self.draft_allocator.free(dblocks)
@@ -368,13 +499,20 @@ class Scheduler:
                     self.queue.appendleft((req, submitted_at))
                     self.stop_admission()
                     return
-                self._slot_blocks[slot] = blocks
+                self._slot_blocks[slot] = slot_blocks
                 if self.spec_k:
                     self._slot_draft_blocks[slot] = dblocks
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(req.prompt, slot_blocks)
+                    self.prefix_cache.note_admission(start_pos,
+                                                     len(req.prompt))
+                    self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
             else:
+                t0 = self.clock()
                 first = self.engine.prefill(slot, req.prompt,
                                             temperature=req.temperature,
                                             top_p=req.top_p, seed=req.seed)
+                self.prefill_seconds += self.clock() - t0
             self.active[slot] = _Slot(req, first, submitted_at, self.clock())
             self.max_concurrent = max(self.max_concurrent, len(self.active))
             self._m_tokens.inc()  # the prefill's first token
@@ -397,6 +535,7 @@ class Scheduler:
             util = self.allocator.used_count / max(self.allocator.capacity, 1)
             self._m_block_util.set(util)
             self.max_block_utilization = max(self.max_block_utilization, util)
+            self._m_blocks_shared.set(self.allocator.shared_count)
         if not self.active:
             return done
         slots = self.engine.slots
@@ -512,7 +651,42 @@ class Scheduler:
             if stop is not None and self.admission_open and stop():
                 self.stop_admission()
             self.step()
+        # drain/idle contract: every block is free or cache-held — a leak
+        # here is a refcount bug, turned into a hard failure (tests drive
+        # run(); serve.py audits non-strict to keep its exit-0 contract)
+        self.audit_block_leaks(strict=True)
         return self.completed
+
+    def audit_block_leaks(self, strict: bool = True) -> List[str]:
+        """Allocator leak guard for the drained/idle state (no active
+        slots): every target-pool block must be either free or held solely
+        by the prefix cache (exactly one reference), and the draft pool —
+        which opts out of caching — must be fully free. Violations are
+        audited ONCE (``[KV LEAK]``) through the flight recorder and, in
+        strict mode, raised. Returns the violation descriptions."""
+        if self.kv_layout != "paged" or self.active:
+            return []
+        leaks: List[str] = []
+        cached = (self.prefix_cache.cached_blocks
+                  if self.prefix_cache is not None else 0)
+        extra = self.allocator.used_count - cached
+        if extra != 0 or self.allocator.shared_count or self._slot_blocks:
+            leaks.append(AUDIT_KV_LEAK_FMT.format(
+                pool="target", leaked=extra,
+                used=self.allocator.used_count, cached=cached))
+        if self.spec_k and (self.draft_allocator.used_count
+                            or self._slot_draft_blocks):
+            leaks.append(AUDIT_KV_LEAK_FMT.format(
+                pool="draft", leaked=self.draft_allocator.used_count,
+                used=self.draft_allocator.used_count, cached=0))
+        if leaks and not self._leak_audited:
+            self._leak_audited = True
+            for text in leaks:
+                events.emit_audit(logger, text, "kv_leak")
+        if leaks and strict:
+            raise RuntimeError("KV block leak after drain: "
+                               + "; ".join(leaks))
+        return leaks
 
     # --- aggregate metrics -------------------------------------------------
 
@@ -533,11 +707,22 @@ class Scheduler:
             "tokens_per_sec": tps,
             "tokens_per_sec_per_slot": tps / max(self.engine.slots, 1),
             "prefill_chunks": self.prefill_chunks,
+            "prefill_seconds": self.prefill_seconds,
         }
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count
             out["kv_block_utilization_peak"] = self.max_block_utilization
+            if self.prefix_cache is not None:
+                pc = self.prefix_cache
+                out["prefix_lookups"] = pc.lookups
+                out["prefix_hits"] = pc.hits
+                out["prefix_hit_tokens"] = pc.hit_tokens
+                out["prefix_hit_rate"] = pc.hit_rate
+                out["prefix_cached_blocks"] = pc.cached_blocks
+                out["prefix_evictions"] = pc.evictions
+                out["prefix_cow_copies"] = pc.cow_copies
+                out["kv_blocks_shared"] = self.allocator.shared_count
         if self.spec_k:
             out["spec_k"] = self.spec_k
             out["spec_rounds"] = self.spec_rounds

@@ -105,6 +105,14 @@ def gather_kv_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray
     ``b`` is ``pool[block_tables[b, p // bs], :, p % bs]`` — exactly the
     ring buffer's content for every written position, and null-block/stale
     content beyond a slot's length, which the caller's length mask zeroes.
+
+    The gather is a pure READ of the tables, so the same pool block may
+    appear in several slots' rows at once — that is how the prefix cache
+    (inference/prefix_cache.py) serves shared prompt prefixes with zero
+    kernel changes: hit blocks are simply referenced by more than one row.
+    Writes never target a shared block (the scheduler copy-on-writes it
+    into a private block first), so concurrent readers always see
+    committed, immutable bytes.
     """
     g = pool[block_tables]                     # (B, NB, K, bs, D)
     b, nb, k, bs, d = g.shape
@@ -128,6 +136,13 @@ def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     contiguous view instead of a fused block-indexed kernel, which is the
     right first rung on CPU/XLA and the semantics a later Pallas kernel
     must reproduce.
+
+    Prefix sharing needs NO change here: a block referenced by several
+    slots' table rows (prefix-cache hit) is gathered into each of their
+    views with bit-identical contents, and since shared blocks are
+    read-only (copy-on-write precedes any write into one), a cache-hit
+    slot's gathered view equals what its own prefill would have produced —
+    the root of the cached-stream bit-exactness tests.
     """
     return cached_attention(q, gather_kv_blocks(k_pool, block_tables),
                             gather_kv_blocks(v_pool, block_tables), offsets)

@@ -69,6 +69,13 @@ AUDIT_REQUEST_DONE_FMT = ("Request {id} done | {reason} | prompt "
                           "{prompt_tokens} tok | generated {new_tokens} tok "
                           "| ttft {ttft_ms:.0f} ms | {tps:.1f} tok/s")
 AUDIT_SERVE_COMPLETED = "Serving completed"
+AUDIT_SERVE_PREFIX_FMT = ("Prefix cache | lookups {lookups} | hit rate "
+                          "{rate:.3f} | hit tokens {hit_tokens} | cached "
+                          "blocks {cached} | cow copies {cow} | evictions "
+                          "{evictions}")
+AUDIT_KV_LEAK_FMT = ("[KV LEAK] {pool} pool: {leaked} block(s) leaked "
+                     "after drain ({used} allocated, {cached} "
+                     "prefix-cached)")
 
 # --- Chaos + checkpoint-integrity audit trail (chaos/injector.py,
 # checkpoint/manager.py) — same contract: these strings are what

@@ -65,6 +65,13 @@ FROZEN = {
         "generated {new_tokens} tok | ttft {ttft_ms:.0f} ms | "
         "{tps:.1f} tok/s",
     "AUDIT_SERVE_COMPLETED": "Serving completed",
+    "AUDIT_SERVE_PREFIX_FMT":
+        "Prefix cache | lookups {lookups} | hit rate {rate:.3f} | "
+        "hit tokens {hit_tokens} | cached blocks {cached} | "
+        "cow copies {cow} | evictions {evictions}",
+    "AUDIT_KV_LEAK_FMT":
+        "[KV LEAK] {pool} pool: {leaked} block(s) leaked after drain "
+        "({used} allocated, {cached} prefix-cached)",
     "AUDIT_CHAOS_INJECT_FMT": "[CHAOS] Injected {fault} at step {step}",
     "AUDIT_CKPT_VERIFY_FAILED_FMT":
         "[CKPT VERIFY] Checkpoint step {step} failed integrity check: "

@@ -327,6 +327,7 @@ def test_serve_restores_checkpoint_and_completes(trained_ckpt):
     assert "Serving ready | model tiny | checkpoint step 5 | slots 2" in out
     for i in range(3):
         assert f"Request req{i} done" in out, out
+    assert "Prefix cache | lookups 3 |" in out  # summary audit, cache on
     assert "Serving completed" in out
     assert "[EXIT HANDLER]" not in out  # no drain on the happy path
 
@@ -348,6 +349,10 @@ def test_serve_sigterm_drains_and_exits_zero(trained_ckpt):
     assert "admission stopped." in out
     assert "[EXIT HANDLER] Drained;" in out
     assert "queued request(s) not admitted." in out
+    # 40 identical prompts: every admission past the first hits the
+    # first committed block, so the summary audit shows a nonzero rate
+    assert "Prefix cache | lookups" in out
+    assert "hit rate 0.000" not in out.split("Prefix cache | ")[1], out
     assert "Serving completed" in out
     # drained means NOT all 40 requests ran; at least the in-flight finished
     done = out.count("done | length")

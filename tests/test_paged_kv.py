@@ -177,6 +177,11 @@ def test_paged_stream_bitmatches_ring(engines):
     paged_sched, paged_out = _stream(paged, list(reqs))
     assert paged_out == ring_out
     assert len(paged_out) == 4
+    # after drain the prefix cache legitimately retains committed prompt
+    # blocks (one cache reference each); flushing must return ALL of them
+    assert (paged_sched.allocator.used_count
+            == paged_sched.prefix_cache.cached_blocks)
+    paged_sched.prefix_cache.flush()
     assert paged_sched.allocator.free_count == paged_sched.allocator.capacity
     assert not paged_sched.block_tables.any()
 

@@ -201,7 +201,11 @@ def test_greedy_spec_stream_bitmatches_nonspec_paged():
                            draft_num_blocks=7, **kw)
     got, sched = streams(spec)
     assert got == want
-    # both pools fully drained back to the free lists
+    # both pools fully drained back to the free lists (the target pool via
+    # a prefix-cache flush: committed prompt blocks stay cache-held after
+    # drain; the draft pool opts out of caching so it must already be free)
+    assert sched.allocator.used_count == sched.prefix_cache.cached_blocks
+    sched.prefix_cache.flush()
     assert sched.allocator.free_count == sched.allocator.capacity
     assert sched.draft_allocator.free_count == sched.draft_allocator.capacity
     m = sched.metrics()
