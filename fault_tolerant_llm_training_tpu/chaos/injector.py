@@ -15,7 +15,19 @@ exposes one hook per injection site:
 - :meth:`post_fault_save` — ft/handler.py, after the exit handler's fault
   checkpoint commits: ``ckpt_corrupt`` flips bytes in the newest step dir
   (AFTER its integrity manifest is written, so the next restore must catch
-  it and fall back).
+  it and fall back);
+- :meth:`on_publish` — deploy/publish.py, after the ``published.json``
+  pointer commits: ``publish_corrupt`` flips a byte in the published
+  step's files, so the serving watcher's verify-before-load must reject
+  the publish;
+- :meth:`on_reload` — deploy/reload.py, keyed by reload ordinal (1 = the
+  first swap): ``reload_signal`` delivers a real SIGUSR1 in the middle of
+  a hot weight swap.
+
+Trigger kinds beyond ``step=N`` (chaos/schedule.py): ``t=DUR`` entries
+fire at the first injection-site visit after DUR has elapsed since this
+injector was constructed, and ``p=PROB`` entries fire with seeded
+per-visit probability — both latch exactly once like step entries.
 
 Every firing is recorded three ways at once: the ``AUDIT_CHAOS_INJECT_FMT``
 audit line, one flight-recorder event typed ``chaos_<fault>``
@@ -53,6 +65,7 @@ class ChaosInjector:
         self.entries = entries
         self.rng = np.random.default_rng(seed)
         self._corrupt_armed: Optional[ChaosEntry] = None
+        self._t0 = time.monotonic()  # epoch for t= (time-triggered) entries
 
     @classmethod
     def from_config(cls, cfg) -> Optional["ChaosInjector"]:
@@ -72,7 +85,12 @@ class ChaosInjector:
     def describe(self) -> str:
         parts = []
         for e in self.entries:
-            tok = f"step={e.step}:{e.fault}"
+            if e.trigger == "time":
+                tok = f"t={e.when:g}s:{e.fault}"
+            elif e.trigger == "prob":
+                tok = f"p={e.when:g}:{e.fault}"
+            else:
+                tok = f"step={e.step}:{e.fault}"
             if e.arg is not None:
                 tok += f"={e.arg:g}s"
             if e.rank >= 0:
@@ -81,21 +99,32 @@ class ChaosInjector:
         return "; ".join(parts)
 
     # ------------------------------------------------------------- internals
+    def _due(self, entry: ChaosEntry, step: int) -> bool:
+        if entry.trigger == "time":
+            return time.monotonic() - self._t0 >= entry.when
+        if entry.trigger == "prob":
+            return float(self.rng.random()) < entry.when
+        return entry.step == step
+
     def _pending(self, faults, step: int) -> List[ChaosEntry]:
         return [e for e in self.entries
-                if not e.fired and e.fault in faults and e.step == step]
+                if not e.fired and e.fault in faults
+                and self._due(e, step)]
 
-    def _fire(self, entry: ChaosEntry, **payload) -> None:
+    def _fire(self, entry: ChaosEntry, at_step: Optional[int] = None,
+              **payload) -> None:
         """Latch the entry and record the injection everywhere at once —
         before the fault itself acts, so a fault that kills the process
-        still leaves its own trail."""
+        still leaves its own trail. ``at_step`` overrides the audited step
+        for time/probability-triggered entries (their ``step`` field is a
+        placeholder 0, the firing site's step is the informative one)."""
         entry.fired = True
+        step = entry.step if at_step is None else at_step
         _M_INJECTED.labels(**{"class": entry.fault}).inc()
         events.emit_audit(
             logger,
-            AUDIT_CHAOS_INJECT_FMT.format(fault=entry.fault,
-                                          step=entry.step),
-            f"chaos_{entry.fault}", step=entry.step, fault=entry.fault,
+            AUDIT_CHAOS_INJECT_FMT.format(fault=entry.fault, step=step),
+            f"chaos_{entry.fault}", step=step, fault=entry.fault,
             **payload)
         events.flush()
 
@@ -130,13 +159,13 @@ class ChaosInjector:
                 continue
             signum = (_signal.SIGUSR1 if e.fault == "sigusr1"
                       else _signal.SIGTERM)
-            self._fire(e, signum=int(signum))
+            self._fire(e, at_step=step, signum=int(signum))
             ft_signals.inject(signum)
         for e in self._pending(("ckpt_corrupt",), step):
             # Two-phase fault: die like a training error now (the exit
             # handler saves the fault checkpoint), corrupt that checkpoint
             # in post_fault_save once it has committed.
-            self._fire(e, phase="raise")
+            self._fire(e, at_step=step, phase="raise")
             self._corrupt_armed = e
             if trainer is not None:
                 trainer._drain_inflight()
@@ -150,10 +179,10 @@ class ChaosInjector:
         from ..ft.multihost import PeerHostError
 
         for e in self._pending(("kv_delay",), step):
-            self._fire(e, seconds=e.arg)
+            self._fire(e, at_step=step, seconds=e.arg)
             time.sleep(e.arg or 0.0)
         for e in self._pending(("kv_fail",), step):
-            self._fire(e)
+            self._fire(e, at_step=step)
             if trainer is not None:
                 trainer.error_is_replicated = True
             raise PeerHostError()
@@ -163,7 +192,7 @@ class ChaosInjector:
         step the produced batch will feed, BEFORE it is handed to the
         consumer: ``loader_stall`` delays that batch's delivery."""
         for e in self._pending(("loader_stall",), batch_step):
-            self._fire(e, seconds=e.arg)
+            self._fire(e, at_step=batch_step, seconds=e.arg)
             time.sleep(e.arg or 0.0)
 
     def on_serve_step(self, iteration: int) -> None:
@@ -173,8 +202,37 @@ class ChaosInjector:
         for e in self._pending(("sigusr1", "sigterm"), iteration):
             signum = (_signal.SIGUSR1 if e.fault == "sigusr1"
                       else _signal.SIGTERM)
-            self._fire(e, signum=int(signum), serve=True)
+            self._fire(e, at_step=iteration, signum=int(signum), serve=True)
             ft_signals.inject(signum)
+
+    def on_publish(self, step_dir: str, step: int, log) -> Optional[str]:
+        """Publisher hook (deploy/publish.py), called AFTER the
+        ``published.json`` pointer commit: ``publish_corrupt`` flips one
+        seeded byte in the published step's files — the manifest stays
+        intact, so the watcher's verify-before-load must catch the CRC
+        mismatch and reject the publish. Returns the corrupted path."""
+        corrupted = None
+        for e in self._pending(("publish_corrupt",), step):
+            self._fire(e, at_step=step, phase="corrupt")
+            flipped = self._flip_byte(step_dir, log,
+                                      what=f"published step {step}")
+            if flipped is not None:
+                corrupted, rel, offset = flipped
+                events.emit(kind="chaos_publish_corrupt", step=int(step),
+                            phase="corrupted", file=rel, offset=offset)
+                events.flush()
+        return corrupted
+
+    def on_reload(self, ordinal: int) -> None:
+        """Hot-reload hook (deploy/reload.py), called in the MIDDLE of a
+        weight swap (new params restored, not yet installed), keyed by
+        reload ordinal (1 = first swap): ``reload_signal`` delivers a real
+        SIGUSR1 there — the swap must complete, and the serve loop's next
+        flag check drains on the new weights."""
+        for e in self._pending(("reload_signal",), ordinal):
+            self._fire(e, at_step=ordinal, signum=int(_signal.SIGUSR1),
+                       reload=True)
+            ft_signals.inject(_signal.SIGUSR1)
 
     def post_fault_save(self, checkpoint_dir: str, saved_step: int,
                         log) -> Optional[str]:
@@ -187,6 +245,22 @@ class ChaosInjector:
             return None
         entry, self._corrupt_armed = self._corrupt_armed, None
         step_dir = os.path.join(checkpoint_dir, str(saved_step))
+        flipped = self._flip_byte(step_dir, log,
+                                  what=f"checkpoint step {saved_step}")
+        if flipped is None:
+            return None
+        target, rel, offset = flipped
+        events.emit(kind="chaos_ckpt_corrupt", step=entry.step,
+                    phase="corrupted", saved_step=int(saved_step),
+                    file=rel, offset=offset)
+        events.flush()
+        return target
+
+    def _flip_byte(self, step_dir: str, log, what: str):
+        """Seeded single-byte XOR in one of a step dir's files (the
+        integrity manifest itself is spared — the corruption must be the
+        kind the manifest CATCHES). Returns ``(path, rel, offset)`` or
+        None if the dir holds nothing flippable."""
         candidates = []
         for root, _dirs, names in os.walk(step_dir):
             for name in names:
@@ -201,7 +275,7 @@ class ChaosInjector:
                              if f"{os.sep}state{os.sep}" in c)
         pool = state_files or sorted(candidates)
         if not pool:
-            log.warning(f"[CHAOS] ckpt_corrupt armed but no files found "
+            log.warning(f"[CHAOS] corruption armed but no files found "
                         f"under {step_dir}")
             return None
         target = pool[int(self.rng.integers(len(pool)))]
@@ -214,14 +288,10 @@ class ChaosInjector:
             fh.write(bytes([byte[0] ^ 0xFF]))
             fh.flush()
             os.fsync(fh.fileno())
-        rel = os.path.relpath(target, checkpoint_dir)
-        log.info(f"[CHAOS] Corrupted checkpoint step {saved_step}: "
+        rel = os.path.relpath(target, os.path.dirname(step_dir))
+        log.info(f"[CHAOS] Corrupted {what}: "
                  f"flipped byte {offset} of {rel}")
-        events.emit(kind="chaos_ckpt_corrupt", step=entry.step,
-                    phase="corrupted", saved_step=int(saved_step),
-                    file=rel, offset=offset)
-        events.flush()
-        return target
+        return target, rel, offset
 
 
 def _process_index() -> int:

@@ -1,14 +1,25 @@
 """Declarative fault-schedule grammar.
 
-One schedule entry names one fault at one global step::
+One schedule entry names one fault at one trigger::
 
-    step=<N>:<fault>[=<arg>][@rank=<R>]
+    step=<N>:<fault>[=<arg>][@rank=<R>]     fire at global step N
+    t=<DUR>:<fault>[=<arg>][@rank=<R>]      fire once DUR has elapsed since
+                                            the injector was built
+    p=<PROB>:<fault>[=<arg>][@rank=<R>]     fire with probability PROB at
+                                            each injection-site visit
+                                            (seeded; 0 < PROB <= 1)
 
 entries separated by ``;``. Examples:
 
     --chaos "step=50:sigusr1"
     --chaos "step=80:exception@rank=1"
     --chaos "step=120:ckpt_corrupt;step=140:loader_stall=5s"
+    --chaos "t=30s:sigterm"
+    --chaos "p=0.1:kv_delay=250ms"
+
+Every entry — whatever its trigger — fires at most ONCE per process
+(``ChaosEntry.fired`` latches), so a ``p=`` entry is "at a seeded-random
+step", not a persistent failure rate.
 
 ``--chaos`` also accepts a JSON file path (detected by an existing file or
 an ``@`` prefix) holding a list of ``{"step": N, "fault": "...",
@@ -35,6 +46,14 @@ kv_delay        sleep at a signal-sync boundary, simulating a slow
                 multihost KV agreement round (arg = duration, default 1s)
 kv_fail         raise PeerHostError at a sync boundary, simulating a
                 failed agreement round / lost peer
+publish_corrupt flip bytes in a just-published checkpoint's files AFTER
+                the ``published.json`` pointer commits (deploy/publish.py)
+                — the serving watcher's verify-before-load must reject the
+                publish and keep serving on current weights
+reload_signal   deliver a real SIGUSR1 in the middle of a hot weight swap
+                (deploy/reload.py), keyed by reload ordinal (1 = first
+                reload) — the swap must complete and the drain then run
+                on the NEW weights
 ==============  ============================================================
 
 Steps are *global* training steps, so an entry in the past at resume time
@@ -57,28 +76,37 @@ FAULTS = {
     "loader_stall": 2.0,
     "kv_delay": 1.0,
     "kv_fail": None,
+    "publish_corrupt": None,
+    "reload_signal": None,
 }
 
 # The serving loop has no training steps, prefetcher or KV agreement: only
-# the signal faults make sense there (a mid-decode drain).
-SERVE_FAULTS = ("sigusr1", "sigterm")
+# the signal faults (a mid-decode drain) and the mid-swap reload signal
+# make sense there.
+SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ENTRY_RE = re.compile(
-    r"^step=(?P<step>-?\d+):(?P<fault>[a-z_0-9]+)"
+    r"^(?P<trigger>step|t|p)=(?P<when>[^:]+):(?P<fault>[a-z_0-9]+)"
     r"(?:=(?P<arg>[^@]+))?(?:@rank=(?P<rank>-?\d+))?$")
 
 
 @dataclasses.dataclass
 class ChaosEntry:
     """One scheduled injection. ``fired`` latches after the injector acts:
-    every entry fires exactly once per process lifetime."""
+    every entry fires exactly once per process lifetime. ``trigger``
+    selects when: ``"step"`` compares the injection site's step to
+    ``step``; ``"time"`` fires once ``when`` seconds have elapsed since
+    the injector was built; ``"prob"`` fires with per-visit probability
+    ``when`` from the injector's seeded rng."""
 
     step: int
     fault: str
     arg: Optional[float] = None  # seconds, for duration faults
     rank: int = -1  # -1 = every process; >=0 = that process index only
     fired: bool = False
+    trigger: str = "step"  # "step" | "time" | "prob"
+    when: float = 0.0  # time: seconds since start; prob: probability
 
 
 def parse_duration(text: str) -> float:
@@ -91,7 +119,8 @@ def parse_duration(text: str) -> float:
     return value / 1000.0 if m.group(2) == "ms" else value
 
 
-def _validate(step, fault, arg, rank) -> ChaosEntry:
+def _validate(step, fault, arg, rank, trigger="step",
+              when=0.0) -> ChaosEntry:
     if fault not in FAULTS:
         raise ValueError(
             f"unknown chaos fault {fault!r} (known: {sorted(FAULTS)})")
@@ -108,7 +137,27 @@ def _validate(step, fault, arg, rank) -> ChaosEntry:
         if seconds < 0:
             raise ValueError(f"chaos duration must be >= 0, got {seconds}")
     return ChaosEntry(step=step, fault=fault, arg=seconds,
-                      rank=int(rank if rank is not None else -1))
+                      rank=int(rank if rank is not None else -1),
+                      trigger=trigger, when=float(when))
+
+
+def _trigger_fields(trigger: str, value) -> dict:
+    """Map one (trigger, value) pair to _validate kwargs, failing fast on
+    out-of-range values — a typo'd schedule must die at parse time, not
+    silently never fire mid-campaign."""
+    if trigger == "step":
+        return {"step": value}
+    if trigger == "t":
+        seconds = parse_duration(value)
+        return {"step": 0, "trigger": "time", "when": seconds}
+    try:
+        prob = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(f"bad chaos probability {value!r} "
+                         f"(want a float in (0, 1])")
+    if not 0.0 < prob <= 1.0:
+        raise ValueError(f"chaos probability must be in (0, 1], got {prob}")
+    return {"step": 0, "trigger": "prob", "when": prob}
 
 
 def _parse_entry(token: str) -> ChaosEntry:
@@ -116,9 +165,11 @@ def _parse_entry(token: str) -> ChaosEntry:
     if not m:
         raise ValueError(
             f"bad chaos entry {token!r} (want "
-            f"'step=<N>:<fault>[=<arg>][@rank=<R>]')")
-    return _validate(m.group("step"), m.group("fault"), m.group("arg"),
-                     m.group("rank"))
+            f"'step=<N>:<fault>[=<arg>][@rank=<R>]', or 't=<dur>:' / "
+            f"'p=<prob>:' in place of 'step=<N>:')")
+    return _validate(fault=m.group("fault"), arg=m.group("arg"),
+                     rank=m.group("rank"),
+                     **_trigger_fields(m.group("trigger"), m.group("when")))
 
 
 def _parse_json(path: str) -> List[ChaosEntry]:
@@ -132,13 +183,18 @@ def _parse_json(path: str) -> List[ChaosEntry]:
             f"with a 'schedule' list)")
     out = []
     for i, item in enumerate(data):
-        if not isinstance(item, dict) or "step" not in item \
+        triggers = ([k for k in ("step", "t", "p") if k in item]
+                    if isinstance(item, dict) else [])
+        if not isinstance(item, dict) or len(triggers) != 1 \
                 or "fault" not in item:
             raise ValueError(
                 f"chaos JSON {path!r} entry {i} needs 'step' and 'fault' "
-                f"keys, got {item!r}")
-        out.append(_validate(item["step"], item["fault"], item.get("arg"),
-                             item.get("rank")))
+                f"keys (or exactly one of 't'/'p' in place of 'step'), "
+                f"got {item!r}")
+        out.append(_validate(fault=item["fault"], arg=item.get("arg"),
+                             rank=item.get("rank"),
+                             **_trigger_fields(triggers[0],
+                                               item[triggers[0]])))
     return out
 
 

@@ -1,0 +1,19 @@
+"""Continuous train->serve deployment loop.
+
+``publish.py`` is the trainer side: after each integrity-manifest commit
+it atomically updates a ``published.json`` pointer next to the Orbax
+root. ``reload.py`` is the serving side: a watcher polls the pointer,
+verifies the manifest BEFORE load, and hot-swaps the engine's weights in
+a prefill-pause without dropping in-flight requests.
+"""
+
+from .publish import (  # noqa: F401
+    POINTER_NAME,
+    Pointer,
+    Publisher,
+    manifest_digest,
+    read_pointer,
+    verify_pointer,
+    write_pointer,
+)
+from .reload import HotReloader, PointerWatcher  # noqa: F401

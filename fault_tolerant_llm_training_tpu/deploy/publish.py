@@ -1,0 +1,274 @@
+"""Verified checkpoint publishing: the trainer's half of the deploy loop.
+
+A publish is ONE atomic pointer write: ``published.json`` next to the
+Orbax checkpoint root names the step directory serving should load, plus
+the sha256 of that step's ``integrity.json`` — so the serving watcher can
+prove the manifest it verifies against is the manifest that was published,
+not a later rewrite. The pointer write follows the same atomic
+tmp + fsync + rename + dir-fsync discipline as the manifest itself
+(checkpoint/manager.py): a reader never observes a torn pointer, and a
+crash mid-publish leaves the previous pointer intact.
+
+The pointer optionally carries a ``draft`` sub-pointer (same fields) so a
+speculative-decoding deployment can refresh target and draft weights in
+the same serving-side swap.
+
+``python -m fault_tolerant_llm_training_tpu.deploy.publish`` republishes
+any manifested step by hand — the campaign driver uses it to stage
+rollbacks and chaos-corrupted publishes.
+"""
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from typing import Optional, Tuple
+
+from ..checkpoint.manager import MANIFEST_NAME, _fsync_dir, verify_step_dir
+from ..obs import events
+from ..obs.registry import REGISTRY
+from ..utils.logging import AUDIT_PUBLISH_FMT, init_logger, logger
+
+POINTER_NAME = "published.json"
+
+_M_PUBLISHED = REGISTRY.counter(
+    "ftl_publish_total",
+    "Checkpoint pointer publishes committed by this process")
+_M_PUBLISHED_STEP = REGISTRY.gauge(
+    "ftl_published_step",
+    "Step of the most recently published checkpoint pointer")
+
+
+@dataclasses.dataclass
+class Pointer:
+    """One published checkpoint: what serving should load and how to
+    verify it. ``path`` is the step directory relative to the checkpoint
+    root (the directory holding ``published.json``); ``draft`` is an
+    optional dict with the same ``step``/``job_id``/``path``/
+    ``manifest_digest`` keys for the speculative draft model."""
+
+    step: int
+    job_id: str
+    path: str
+    manifest_digest: str
+    draft: Optional[dict] = None
+    version: int = 1
+
+
+def pointer_path(root: str) -> str:
+    return os.path.join(os.path.abspath(root), POINTER_NAME)
+
+
+def manifest_digest(step_dir: str) -> Optional[str]:
+    """sha256 hex of the step's ``integrity.json`` bytes (None if the step
+    has no manifest — such a step is not publishable: the watcher could
+    not verify what it loads)."""
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.isfile(path):
+        return None
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def write_pointer(root: str, ptr: Pointer) -> str:
+    """Atomic pointer commit, same discipline as ``write_manifest``."""
+    final = pointer_path(root)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(dataclasses.asdict(ptr), fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, final)
+    _fsync_dir(os.path.dirname(final))
+    return final
+
+
+def read_pointer(root: str) -> Optional[Pointer]:
+    """Read ``published.json`` tolerantly: a missing, torn, or
+    wrong-shaped pointer reads as None (the watcher just polls again) —
+    the atomic write makes torn reads near-impossible, but a reader must
+    never crash the serving process over a pointer file."""
+    try:
+        with open(pointer_path(root)) as fh:
+            data = json.load(fh)
+        return Pointer(step=int(data["step"]), job_id=str(data["job_id"]),
+                       path=str(data["path"]),
+                       manifest_digest=str(data["manifest_digest"]),
+                       draft=data.get("draft"),
+                       version=int(data.get("version", 1)))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _verify_target(root: str, path: str, digest: str) -> Tuple[bool, str]:
+    step_dir = os.path.join(os.path.abspath(root), path)
+    actual = manifest_digest(step_dir)
+    if actual is None:
+        return False, f"published step has no integrity manifest ({path})"
+    if actual != digest:
+        return False, (f"manifest digest mismatch ({path}): the published "
+                       f"manifest was replaced after publish")
+    ok, detail = verify_step_dir(step_dir)
+    if not ok:
+        return False, f"integrity check failed ({path}): {detail}"
+    return True, "ok"
+
+
+def verify_pointer(root: str, ptr: Pointer) -> Tuple[bool, str]:
+    """Verify-before-load: the published step's manifest must be the one
+    that was published (sha256) AND every manifest-listed file must pass
+    its size/CRC check — for the draft sub-pointer too, when present.
+    Returns ``(ok, detail)``."""
+    ok, detail = _verify_target(root, ptr.path, ptr.manifest_digest)
+    if not ok:
+        return ok, detail
+    if ptr.draft is not None:
+        try:
+            ok, detail = _verify_target(root, str(ptr.draft["path"]),
+                                        str(ptr.draft["manifest_digest"]))
+        except (KeyError, TypeError):
+            return False, "malformed draft sub-pointer"
+        if not ok:
+            return False, f"draft {detail}"
+    return True, "ok"
+
+
+def newest_manifested_step(root: str, job_id: str) -> Optional[int]:
+    """Newest finalized step of ``checkpoint_{job_id}`` that carries an
+    integrity manifest (the publishable set)."""
+    d = os.path.join(os.path.abspath(root), f"checkpoint_{job_id}")
+    if not os.path.isdir(d):
+        return None
+    steps = sorted((int(n) for n in os.listdir(d) if n.isdigit()),
+                   reverse=True)
+    for step in steps:
+        if manifest_digest(os.path.join(d, str(step))) is not None:
+            return step
+    return None
+
+
+class Publisher:
+    """Atomically points serving at a verified checkpoint step.
+
+    The trainer calls :meth:`publish` after each periodic save's
+    integrity sweep (training/loop.py, host 0 only); the CLI below drives
+    the same path by hand. A chaos injector hooks the moment AFTER the
+    pointer commit (``publish_corrupt``) so campaigns can prove the
+    serving watcher rejects a corrupted publish.
+    """
+
+    def __init__(self, checkpoint_path: str, job_id: str, chaos=None):
+        self.root = os.path.abspath(checkpoint_path)
+        self.job_id = str(job_id)
+        self.chaos = chaos
+
+    def step_dir(self, step: int, job_id: Optional[str] = None) -> str:
+        return os.path.join(self.root, f"checkpoint_{job_id or self.job_id}",
+                            str(step))
+
+    def publish(self, step: int,
+                draft: Optional[dict] = None) -> Optional[Pointer]:
+        """Publish ``step`` (which must carry an integrity manifest);
+        returns the committed pointer, or None if the step is not
+        publishable. ``draft`` is an optional pre-built draft sub-pointer
+        dict (see :func:`draft_pointer`)."""
+        step_dir = self.step_dir(step)
+        digest = manifest_digest(step_dir)
+        if digest is None:
+            logger.warning(
+                f"[DEPLOY] step {step} has no integrity manifest under "
+                f"{step_dir}; not publishing")
+            return None
+        ptr = Pointer(step=int(step), job_id=self.job_id,
+                      path=os.path.relpath(step_dir, self.root),
+                      manifest_digest=digest, draft=draft)
+        write_pointer(self.root, ptr)
+        _M_PUBLISHED.inc()
+        _M_PUBLISHED_STEP.set(int(step))
+        events.emit_audit(
+            logger,
+            AUDIT_PUBLISH_FMT.format(step=int(step), digest=digest[:12]),
+            "publish", step=int(step), digest=digest, path=ptr.path,
+            draft=bool(draft))
+        events.flush()
+        if self.chaos is not None:
+            # post-commit corruption window: the pointer is live, the
+            # files it names get flipped — exactly what verify-before-load
+            # exists to catch
+            self.chaos.on_publish(step_dir, int(step), logger)
+        return ptr
+
+    def draft_pointer(self, job_id: str,
+                      step: Optional[int] = None) -> Optional[dict]:
+        """Build a draft sub-pointer for a draft trained into the same
+        checkpoint root (its own ``checkpoint_{job_id}``)."""
+        if step is None:
+            step = newest_manifested_step(self.root, job_id)
+            if step is None:
+                return None
+        step_dir = self.step_dir(step, job_id=job_id)
+        digest = manifest_digest(step_dir)
+        if digest is None:
+            return None
+        return {"step": int(step), "job_id": str(job_id),
+                "path": os.path.relpath(step_dir, self.root),
+                "manifest_digest": digest}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fault_tolerant_llm_training_tpu.deploy.publish",
+        description="(Re)publish a checkpoint step to published.json — "
+                    "the pointer serving's hot-reload watcher follows.")
+    p.add_argument("--checkpoint-path", required=True,
+                   help="directory passed to training's --checkpoint-path")
+    p.add_argument("--job-id", required=True,
+                   help="job id the checkpoint was written under")
+    p.add_argument("--step", type=int, default=None,
+                   help="step to publish (default: newest manifested)")
+    p.add_argument("--draft-job-id", default="",
+                   help="also publish a draft sub-pointer from this job's "
+                        "checkpoints (same checkpoint root)")
+    p.add_argument("--draft-step", type=int, default=None,
+                   help="draft step (default: newest manifested)")
+    p.add_argument("--chaos", default="",
+                   help="fault schedule keyed by the published step "
+                        "(publish_corrupt only)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--event-log", default="",
+                   help="flight-recorder JSONL path ('' = disabled)")
+    args = p.parse_args(argv)
+
+    init_logger()
+    if args.event_log:
+        events.configure(args.event_log, job="publish", host=os.getpid())
+    chaos = None
+    if args.chaos:
+        from ..chaos import ChaosInjector, parse_schedule
+
+        chaos = ChaosInjector(
+            parse_schedule(args.chaos, allowed=("publish_corrupt",)),
+            seed=args.seed)
+    pub = Publisher(args.checkpoint_path, args.job_id, chaos=chaos)
+    step = args.step
+    if step is None:
+        step = newest_manifested_step(args.checkpoint_path, args.job_id)
+        if step is None:
+            logger.error("[DEPLOY] no manifested checkpoint step to publish")
+            return 2
+    draft = None
+    if args.draft_job_id:
+        draft = pub.draft_pointer(args.draft_job_id, args.draft_step)
+        if draft is None:
+            logger.error("[DEPLOY] no manifested draft checkpoint step "
+                         "to publish")
+            return 2
+    ptr = pub.publish(step, draft=draft)
+    events.flush()
+    return 0 if ptr is not None else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Watcher + verified zero-downtime hot weight reload for serving.
+
+:class:`PointerWatcher` polls the trainer's ``published.json``
+(deploy/publish.py) and offers each distinct publish exactly once.
+:class:`HotReloader` then runs the swap state machine::
+
+    VERIFY  -> pointer digest + per-file CRC check of the published step
+               (and its draft sub-pointer) BEFORE anything is loaded; a
+               failing publish is rejected + audited, serving continues
+               on current weights
+    PAUSE   -> admission closes (Scheduler.stop_admission); the reload is
+               driven between decode iterations, so the in-flight round
+               has already finished — no request is dropped, no slot
+               freed
+    RESTORE -> params restored through the same cross-topology path as
+               startup (engine.restore_params) onto the engine's mesh; a
+               restore that silently fell back to an OLDER step
+               (checkpoint/manager.py _verified_step) is treated as a
+               rejection — the pointer names ONE step, serving never
+               downgrades implicitly
+    SWAP    -> engine.reload_params installs the new arrays into the
+               running AOT programs (no re-compile — the programs take
+               params per call, only the cache is donated); draft params
+               swap in the same pause; the prefix cache is flushed (its
+               cached KV was computed with the OLD weights)
+    RESUME  -> admission reopens; the swap is audited
+               (AUDIT_RELOAD_FMT) with counter + step gauge +
+               swap-latency histogram on /metrics
+
+In-flight requests keep their already-computed KV: from the swap on,
+their decode runs new weights over old-KV context (the standard
+continuous-batching reload semantics — finishing a started stream beats
+dropping it). Requests ADMITTED after the swap run prefill + decode
+entirely under the new weights, so their streams bit-match a fresh serve
+of the published step — the property the chaos campaign pins.
+"""
+
+import os
+import time
+from typing import Optional
+
+from ..obs import events
+from ..obs.registry import REGISTRY
+from ..utils.logging import (
+    AUDIT_RELOAD_FMT,
+    AUDIT_RELOAD_REJECTED_FMT,
+    logger,
+)
+from .publish import Pointer, read_pointer, verify_pointer
+
+_M_RELOADS = REGISTRY.counter(
+    "ftl_weights_reload_total",
+    "Hot weight swaps completed by the serving process")
+_M_REJECTED = REGISTRY.counter(
+    "ftl_weights_reload_rejected_total",
+    "Published checkpoints rejected by verify-before-load")
+_M_STEP = REGISTRY.gauge(
+    "ftl_weights_step",
+    "Checkpoint step of the weights currently being served")
+_M_SWAP = REGISTRY.histogram(
+    "ftl_weights_swap_seconds",
+    "Wall time of one hot weight swap (verify + restore + install)")
+
+
+class PointerWatcher:
+    """Offer each distinct publish of ``published.json`` exactly once.
+
+    Distinctness is the (job_id, step, digest) triple, so a republish of
+    the same step with a rewritten manifest is a NEW offer, while a
+    rejected publish is not re-verified on every poll — the trainer must
+    publish something new to be considered again.
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._seen = None
+
+    def poll(self) -> Optional[Pointer]:
+        ptr = read_pointer(self.root)
+        if ptr is None:
+            return None
+        key = (ptr.job_id, ptr.step, ptr.manifest_digest)
+        if key == self._seen:
+            return None
+        self._seen = key
+        return ptr
+
+
+class HotReloader:
+    """Swap serving weights to a verified published checkpoint in a
+    prefill-pause (module docstring has the state machine)."""
+
+    def __init__(self, engine, scheduler, cfg, checkpoint_path: str,
+                 draft_cfg=None, adaptive_k=None, chaos=None,
+                 clock=time.monotonic):
+        self.engine = engine
+        self.scheduler = scheduler
+        self.cfg = cfg
+        self.draft_cfg = draft_cfg
+        self.root = os.path.abspath(checkpoint_path)
+        self.adaptive_k = adaptive_k
+        self.chaos = chaos
+        self.clock = clock
+        self.reloads = 0
+        self.rejects = 0
+        current = getattr(engine, "restored_step", None)
+        if current is not None:
+            _M_STEP.set(int(current))
+
+    def _reject(self, ptr: Pointer, detail: str, current) -> None:
+        self.rejects += 1
+        _M_REJECTED.inc()
+        events.emit_audit(
+            logger,
+            AUDIT_RELOAD_REJECTED_FMT.format(step=ptr.step, detail=detail,
+                                             current=current),
+            "weights_reload_rejected", step=int(ptr.step), detail=detail,
+            current=current)
+        events.flush()
+
+    def maybe_reload(self, ptr: Optional[Pointer]) -> bool:
+        """Verify + swap to ``ptr``; returns True iff the swap completed.
+        Must be called between scheduler.step() iterations (the serve
+        loop's cadence) so no decode round is in flight."""
+        if ptr is None:
+            return False
+        from ..inference.engine import restore_params
+        from ..models.llama import unstack_layer_params
+
+        current = getattr(self.engine, "restored_step", None)
+        ok, detail = verify_pointer(self.root, ptr)
+        if not ok:
+            self._reject(ptr, detail, current)
+            return False
+        t0 = self.clock()
+        was_open = self.scheduler.admission_open
+        self.scheduler.stop_admission()
+        try:
+            params, got = restore_params(
+                self.root, ptr.job_id, self.cfg, step=ptr.step,
+                mesh=getattr(self.engine, "mesh", None))
+            if got != ptr.step:
+                self._reject(ptr, f"restore fell back to step {got}",
+                             current)
+                return False
+            if self.cfg.layer_impl == "scan":
+                # the engine converted to loop form at build; mirror it
+                params = unstack_layer_params(params, self.cfg.n_layers)
+            draft_params = None
+            if ptr.draft is not None and getattr(self.engine, "spec_k", 0):
+                if self.draft_cfg is None:
+                    self._reject(ptr, "pointer carries a draft but serving "
+                                      "was built without one", current)
+                    return False
+                draft_params, dgot = restore_params(
+                    self.root, str(ptr.draft["job_id"]), self.draft_cfg,
+                    step=int(ptr.draft["step"]),
+                    mesh=getattr(self.engine, "mesh", None))
+                if dgot != int(ptr.draft["step"]):
+                    self._reject(ptr, f"draft restore fell back to step "
+                                      f"{dgot}", current)
+                    return False
+                if self.draft_cfg.layer_impl == "scan":
+                    draft_params = unstack_layer_params(
+                        draft_params, self.draft_cfg.n_layers)
+            if self.chaos is not None:
+                # mid-swap fault window: new params restored but not yet
+                # installed — a reload_signal lands here
+                self.chaos.on_reload(self.reloads + 1)
+            self.engine.reload_params(params)
+            if draft_params is not None:
+                self.engine.reload_draft_params(draft_params)
+                if self.adaptive_k is not None:
+                    # a fresh draft resets the acceptance estimate: start
+                    # optimistic again instead of dragging the stale
+                    # draft's learned-down k into the new regime
+                    self.adaptive_k.reset()
+            if getattr(self.scheduler, "prefix_cache", None) is not None:
+                self.scheduler.prefix_cache.flush()
+            self.engine.restored_step = ptr.step
+            self.reloads += 1
+        except Exception as e:  # a verified step should restore; if the
+            # filesystem disagrees mid-read, reject and keep serving
+            self._reject(ptr, f"restore failed ({e})", current)
+            return False
+        finally:
+            if was_open:
+                self.scheduler.resume_admission()
+        dt = self.clock() - t0
+        _M_RELOADS.inc()
+        _M_STEP.set(int(ptr.step))
+        _M_SWAP.observe(dt)
+        events.emit_audit(
+            logger,
+            AUDIT_RELOAD_FMT.format(old=current, new=ptr.step,
+                                    active=len(self.scheduler.active),
+                                    ms=dt * 1e3),
+            "weights_reload", step=int(ptr.step), old=current, dur=dt,
+            active=len(self.scheduler.active), draft=bool(ptr.draft))
+        events.flush()
+        return True

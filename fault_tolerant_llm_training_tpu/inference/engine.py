@@ -354,7 +354,7 @@ class InferenceEngine:
             v=tuple(copy_kv_block(p, src, dst) for p in cache.v),
             lengths=cache.lengths)
 
-    def _draft_k_fn(self, params, cache, block_tables, tokens, offsets,
+    def _draft_k_fn(self, k, params, cache, block_tables, tokens, offsets,
                     active, temperature, top_p, seeds, rounds):
         """All k chained draft micro-steps in ONE compiled program.
 
@@ -377,8 +377,13 @@ class InferenceEngine:
 
         Returns (cache, draft_tokens (B, k) int32, draft_probs (B, k, V)
         fp32) — consumed by the verify program device-to-device.
+
+        ``k`` is bound with functools.partial before jit (like the prefill
+        programs bind ``model``): the adaptive-k ladder compiles the same
+        body at several round widths. The PRNG stream stride stays
+        ``spec_k + 1`` (the maximum width) whatever ``k`` is, so rounds
+        run at different widths never reuse a draft key.
         """
-        k = self.spec_k
         b = self.slots
         v = self.draft_cfg.vocab_size
         toks0 = jnp.zeros((b, k), jnp.int32)
@@ -395,7 +400,7 @@ class InferenceEngine:
         def body(i, carry):
             ck, cv, cur, toks, probs = carry
             last, ck, cv = micro_step(i, cur, ck, cv)
-            keys = jax.vmap(draft_key)(seeds, rounds * (k + 1) + i)
+            keys = jax.vmap(draft_key)(seeds, rounds * (self.spec_k + 1) + i)
             nxt, p = jax.vmap(sample_token_with_probs,
                               in_axes=(0, 0, 0, 0, None))(
                 last, keys, temperature, top_p, self.top_k)
@@ -411,9 +416,9 @@ class InferenceEngine:
         lengths = jnp.where(active, offsets + k + 1, cache.lengths)
         return PagedKVCache(k=ck, v=cv, lengths=lengths), toks, probs
 
-    def _verify_fn(self, params, cache, block_tables, tokens, draft_tokens,
-                   draft_probs, offsets, active, temperature, top_p, seeds,
-                   rounds):
+    def _verify_fn(self, k, params, cache, block_tables, tokens,
+                   draft_tokens, draft_probs, offsets, active, temperature,
+                   top_p, seeds, rounds):
         """Score all k+1 candidate positions in ONE compiled program and
         accept/resample (sampler.py ``spec_accept``).
 
@@ -442,8 +447,11 @@ class InferenceEngine:
         Commits the accepted prefix by setting lengths to ``offsets +
         accepted + 1``; the rejected suffix's KV is stale pool content
         past that length — masked, then overwritten next round. Inactive
-        slots write into the null block and keep their lengths."""
-        k = self.spec_k
+        slots write into the null block and keep their lengths.
+
+        ``k`` is partial-bound like the draft program's (adaptive-k
+        ladder); ``verify_key`` streams are per-ROUND, so width never
+        enters the key schedule."""
         b = self.slots
         v = self.cfg.vocab_size
         seq = jnp.concatenate([tokens[:, None], draft_tokens], axis=1)
@@ -507,20 +515,8 @@ class InferenceEngine:
             if self.spec_k:
                 dp_abs = _abstract(self.draft_params)
                 dc_abs = _abstract(self.draft_cache)
-                dtoks_abs = jax.ShapeDtypeStruct(
-                    (self.slots, self.spec_k), jnp.int32)
-                dprobs_abs = jax.ShapeDtypeStruct(
-                    (self.slots, self.spec_k, self.cfg.vocab_size),
-                    jnp.float32)
-                self._draft_k = jax.jit(
-                    self._draft_k_fn, donate_argnums=(1,)).lower(
-                    dp_abs, dc_abs, tables_abs, slots_i, slots_i, slots_b,
-                    slots_f, slots_f, slots_i, slots_i).compile()
-                self._verify = jax.jit(
-                    self._verify_fn, donate_argnums=(1,)).lower(
-                    p_abs, c_abs, tables_abs, slots_i, dtoks_abs,
-                    dprobs_abs, slots_i, slots_b, slots_f, slots_f,
-                    slots_i, slots_i).compile()
+                self._spec_programs = {}
+                self._draft_k, self._verify = self._spec_pair(self.spec_k)
                 self._draft_prefill = {}
                 for b in self.prefill_buckets:
                     tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
@@ -542,7 +538,103 @@ class InferenceEngine:
                 p_abs, c_abs, tok_abs, scalar_i, scalar_i, scalar_f,
                 scalar_f, scalar_i).compile()
 
+    def _compile_spec_pair(self, k: int):
+        """AOT-compile one (draft-k, verify) program pair at round width
+        ``k``. The k-value is bound with functools.partial (the draft/
+        verify bodies are width-generic); everything else — shardings,
+        donation, op shapes per micro-step — matches the default pair, so
+        a ladder rung's greedy stream is bit-identical to running the
+        default pair with the extra proposals rejected."""
+        p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
+        dp_abs = _abstract(self.draft_params)
+        dc_abs = _abstract(self.draft_cache)
+        slots_i = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
+        slots_f = jax.ShapeDtypeStruct((self.slots,), jnp.float32)
+        slots_b = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
+        tables_abs = jax.ShapeDtypeStruct(
+            (self.slots, self.max_blocks_per_slot), jnp.int32)
+        dtoks_abs = jax.ShapeDtypeStruct((self.slots, k), jnp.int32)
+        dprobs_abs = jax.ShapeDtypeStruct(
+            (self.slots, k, self.cfg.vocab_size), jnp.float32)
+        draft = jax.jit(
+            functools.partial(self._draft_k_fn, k),
+            donate_argnums=(1,)).lower(
+            dp_abs, dc_abs, tables_abs, slots_i, slots_i, slots_b,
+            slots_f, slots_f, slots_i, slots_i).compile()
+        verify = jax.jit(
+            functools.partial(self._verify_fn, k),
+            donate_argnums=(1,)).lower(
+            p_abs, c_abs, tables_abs, slots_i, dtoks_abs, dprobs_abs,
+            slots_i, slots_b, slots_f, slots_f, slots_i, slots_i).compile()
+        return draft, verify
+
+    def _spec_pair(self, k: int):
+        """The compiled (draft-k, verify) pair for round width ``k``,
+        compiling on first use. The default width ``spec_k`` is compiled
+        at engine build (never a stall); other rungs compile once when an
+        adaptive-k controller first requests them — the controller's
+        ladder is O(log spec_k) wide, so a serving process pays at most a
+        handful of one-time compiles over its whole lifetime, each inside
+        an admission pause."""
+        k = int(k)
+        if not 1 <= k <= self.spec_k:
+            raise ValueError(f"spec round width {k} outside "
+                             f"[1, {self.spec_k}]")
+        pair = self._spec_programs.get(k)
+        if pair is None:
+            pair = self._compile_spec_pair(k)
+            self._spec_programs[k] = pair
+        return pair
+
     # --- host API ----------------------------------------------------------
+
+    def _prepare_params(self, params, current, what: str):
+        """Validate a replacement param tree against the serving one
+        (same structure, shapes, dtypes — the AOT programs were lowered
+        against ``current``'s abstract tree and would otherwise fail
+        opaquely at dispatch), then shard it exactly as ``__init__``
+        does."""
+        cur_leaves, cur_def = jax.tree_util.tree_flatten(current)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if cur_def != new_def:
+            raise ValueError(f"{what} reload: param tree structure does "
+                             f"not match the serving model")
+        for c, n in zip(cur_leaves, new_leaves):
+            if c.shape != n.shape or c.dtype != n.dtype:
+                raise ValueError(
+                    f"{what} reload: param leaf {n.shape}/{n.dtype} does "
+                    f"not match serving {c.shape}/{c.dtype}")
+        with use_mesh(self.mesh):
+            shardings = param_shardings(params, self.mesh)
+            if shardings is not None:
+                params = jax.device_put(params, shardings)
+            return jax.tree_util.tree_map(jnp.asarray, params)
+
+    def reload_params(self, params) -> None:
+        """Hot-swap the TARGET params under the existing AOT programs.
+
+        No re-compile: every program takes params per call and only the
+        cache is donated, so installing a new (structurally identical)
+        tree is one device_put. The caller (deploy/reload.py) owns the
+        surrounding lifecycle — pausing admission, letting the in-flight
+        decode round finish, flushing the prefix cache whose KV was
+        computed under the old weights — and hands the tree over in LOOP
+        form (the engine converted at build; scan-form checkpoints go
+        through ``unstack_layer_params`` first, as the constructor did)."""
+        self.params = self._prepare_params(params, self.params, "target")
+
+    def reload_draft_params(self, params) -> None:
+        """Hot-swap the DRAFT params (speculative decoding) in the same
+        admission pause as :meth:`reload_params`. The draft cache's
+        content becomes stale draft-KV of the OLD draft — harmless: each
+        round re-addresses only the committed prefix, and in-flight
+        slots' acceptance just dips until the new draft's KV dominates
+        (the adaptive-k controller resets alongside)."""
+        if not self.spec_k:
+            raise ValueError("engine built without a draft model "
+                             "(spec_k == 0)")
+        self.draft_params = self._prepare_params(params, self.draft_params,
+                                                 "draft")
 
     def cow_copy(self, src_block: int, dst_block: int) -> None:
         """Copy-on-write one pool block: ``src_block``'s K/V (all layers)
@@ -694,7 +786,8 @@ class InferenceEngine:
         return np.asarray(toks)
 
     def spec_round(self, tokens, lengths, active, temperature, top_p, seeds,
-                   rounds, block_tables=None, draft_block_tables=None):
+                   rounds, block_tables=None, draft_block_tables=None,
+                   k=None):
         """One speculative round over all slots: k draft proposals then one
         verify pass — two dispatches for up to k+1 emitted tokens.
 
@@ -709,12 +802,22 @@ class InferenceEngine:
         (slots,))`` host arrays: slot s emitted ``accepted[s] + 1`` tokens,
         ``out_tokens[s, :accepted[s] + 1]`` (accepted draft prefix plus the
         verify pass's bonus/resampled token).
+
+        ``k`` (default ``spec_k``) selects the round width from the
+        compiled ladder (:meth:`_spec_pair`) — an adaptive-k controller
+        shrinks it when live acceptance drops (e.g. a freshly hot-swapped
+        target running against a stale draft) so a bad draft degrades
+        toward plain decode instead of burning k rejected proposals per
+        round. ``out_tokens`` is then (slots, k+1).
         """
         if not self.spec_k:
             raise ValueError("engine built without a draft model "
                              "(spec_k == 0)")
         if block_tables is None or draft_block_tables is None:
             raise ValueError("spec_round requires both pools' block tables")
+        draft_prog, verify_prog = (
+            (self._draft_k, self._verify) if k is None
+            else self._spec_pair(k))
         toks = np.asarray(tokens, np.int32)
         lens = np.asarray(lengths, np.int32)
         act = np.asarray(active, bool)
@@ -722,11 +825,11 @@ class InferenceEngine:
         tp = np.asarray(top_p, np.float32)
         sd = np.asarray(seeds, np.int32)
         rd = np.asarray(rounds, np.int32)
-        self.draft_cache, d_toks, d_probs = self._draft_k(
+        self.draft_cache, d_toks, d_probs = draft_prog(
             self.draft_params, self.draft_cache,
             np.asarray(draft_block_tables, np.int32), toks, lens, act, temp,
             tp, sd, rd)
-        self.cache, out, acc = self._verify(
+        self.cache, out, acc = verify_prog(
             self.params, self.cache, np.asarray(block_tables, np.int32),
             toks, d_toks, d_probs, lens, act, temp, tp, sd, rd)
         return np.asarray(out), np.asarray(acc)

@@ -22,7 +22,14 @@ filtered distributions:
   acceptance test ``u * q(d) < p(d)`` degenerates to exact argmax matching
   and the resample to the target argmax, so the single kernel serves both
   modes and greedy outputs stay BIT-identical to the non-speculative path.
+
+:class:`AdaptiveK` is the one HOST-side piece here: the controller that
+tunes the round width k from live acceptance, colocated with the accept
+rule whose statistics drive it (the scheduler owns an instance when
+serving opts in with ``--adaptive-spec-k``).
 """
+
+from typing import Dict, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -178,3 +185,77 @@ def spec_accept(draft_tokens: jnp.ndarray, draft_probs: jnp.ndarray,
         [draft_tokens, jnp.zeros((1,), jnp.int32)], axis=0)
     out = jnp.where(idx < a, d_pad, 0).at[a].set(bonus)
     return out, a
+
+
+class AdaptiveK:
+    """Per-request adaptive round width for speculative decoding.
+
+    Each request keeps an EMA of its observed acceptance fraction
+    (accepted / proposed per verify round). Its target width is the
+    expected accepted-run length of a geometric chain at that rate —
+    ``a / (1 - a)`` — clamped to ``[1, k_max]`` and snapped UP to the
+    engine's compiled ladder (powers of two plus ``k_max``, matching
+    ``InferenceEngine._spec_pair``). The batched round runs at the MIN
+    target over active requests: speculation is all-slots-at-once, so the
+    least-accepting stream sets the width everyone pays for.
+
+    A request with no evidence yet is OPTIMISTIC (``k_max``); a stale
+    draft — e.g. the target was hot-swapped and the draft lags a publish —
+    drags acceptance down, the controller walks k toward 1, and serving
+    degrades gracefully toward plain decode instead of burning k rejected
+    proposals per round. :meth:`reset` clears every estimate when a fresh
+    draft is installed (deploy/reload.py), restoring optimism.
+    """
+
+    def __init__(self, k_max: int, decay: float = 0.75):
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {k_max}")
+        if not 0.0 <= decay < 1.0:
+            raise ValueError(f"decay must be in [0, 1), got {decay}")
+        self.k_max = int(k_max)
+        self.decay = float(decay)
+        rungs, r = [], 1
+        while r < self.k_max:
+            rungs.append(r)
+            r *= 2
+        rungs.append(self.k_max)
+        self.rungs = tuple(rungs)
+        self._rate: Dict[str, float] = {}
+
+    def reset(self) -> None:
+        """Forget every estimate (fresh draft installed)."""
+        self._rate.clear()
+
+    def forget(self, request_id: str) -> None:
+        self._rate.pop(request_id, None)
+
+    def observe(self, request_id: str, accepted: int, k: int) -> None:
+        """Fold one verify round's ``accepted`` out of ``k`` proposals into
+        the request's EMA."""
+        if k <= 0:
+            return
+        x = min(max(float(accepted) / float(k), 0.0), 1.0)
+        prev = self._rate.get(request_id)
+        self._rate[request_id] = (x if prev is None
+                                  else self.decay * prev
+                                  + (1.0 - self.decay) * x)
+
+    def acceptance(self, request_id: str) -> Optional[float]:
+        return self._rate.get(request_id)
+
+    def target_k(self, request_id: str) -> int:
+        rate = self._rate.get(request_id)
+        if rate is None:
+            return self.k_max
+        want = rate / max(1.0 - rate, 1e-6)
+        want = min(max(want, 1.0), float(self.k_max))
+        for r in self.rungs:
+            if r >= want:
+                return r
+        return self.k_max
+
+    def round_k(self, request_ids: Iterable[str]) -> int:
+        """Width for one batched round: min target over active requests
+        (``k_max`` when idle)."""
+        targets = [self.target_k(i) for i in request_ids]
+        return min(targets) if targets else self.k_max

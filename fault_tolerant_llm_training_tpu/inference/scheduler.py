@@ -211,7 +211,8 @@ class Scheduler:
     def __init__(self, engine, eos_token_id: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
                  registry: Optional[MetricRegistry] = None,
-                 stop_check: Optional[Callable[[], bool]] = None):
+                 stop_check: Optional[Callable[[], bool]] = None,
+                 adaptive_k=None):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -237,6 +238,10 @@ class Scheduler:
         # Speculative mode: the draft model's pool gets its own allocator
         # and block table; admission requires BOTH footprints (below).
         self.spec_k = int(getattr(engine, "spec_k", 0) or 0)
+        # Optional sampler.AdaptiveK controller: when present, every spec
+        # round runs at its chosen width (min per-request target) instead
+        # of the engine's fixed spec_k — serve.py --adaptive-spec-k.
+        self.adaptive_k = adaptive_k if self.spec_k else None
         if self.spec_k:
             self.draft_allocator = BlockAllocator(engine.draft_num_blocks)
             self.draft_block_tables = np.zeros(
@@ -284,6 +289,10 @@ class Scheduler:
         self._m_spec_rate = r.gauge(
             "ftl_spec_acceptance_rate",
             "Running accepted/proposed draft-token ratio (0-1)")
+        self._m_spec_round_k = r.gauge(
+            "ftl_spec_round_k",
+            "Draft proposals per speculative round (adaptive-k controller "
+            "output; fixed spec_k without one)")
         self._m_spec_round_tokens = r.histogram(
             "ftl_spec_tokens_per_round",
             "Tokens banked per verify round (accepted prefix + bonus, "
@@ -346,6 +355,13 @@ class Scheduler:
         """Drain mode: active slots finish, the queue stays unserved."""
         self.admission_open = False
 
+    def resume_admission(self) -> None:
+        """Reopen admission after a hot weight swap's pause
+        (deploy/reload.py). NOT part of the signal-drain lifecycle — a
+        drain's stop is final for the process; the reloader only restores
+        the admission state it found open."""
+        self.admission_open = True
+
     def pending(self) -> bool:
         return bool(self.active or (self.queue and self.admission_open))
 
@@ -356,6 +372,8 @@ class Scheduler:
 
     def _finish(self, slot: int, reason: str, done: List[Completion]) -> None:
         st = self.active.pop(slot)
+        if self.adaptive_k is not None:
+            self.adaptive_k.forget(st.request.id)
         if self.kv_layout == "paged":
             blocks = self._slot_blocks.pop(slot, None)
             if blocks:
@@ -562,10 +580,19 @@ class Scheduler:
             lengths = np.zeros((slots,), np.int32)
             for s, st in self.active.items():
                 lengths[s] = len(st.request.prompt) + len(st.tokens) - 1
+            round_k = self.spec_k
+            spec_kw = {}
+            if self.adaptive_k is not None:
+                round_k = self.adaptive_k.round_k(
+                    st.request.id for st in self.active.values())
+                # only ladder-aware engines take the width kwarg — test
+                # doubles built before adaptive-k keep the old signature
+                spec_kw["k"] = round_k
+            self._m_spec_round_k.set(round_k)
             out, acc = self.engine.spec_round(
                 tokens, lengths, active, temperature, top_p, seeds, steps,
                 block_tables=self.block_tables,
-                draft_block_tables=self.draft_block_tables)
+                draft_block_tables=self.draft_block_tables, **spec_kw)
         elif self.kv_layout == "paged":
             next_tokens = self.engine.decode_step(
                 tokens, active, temperature, top_p, seeds, steps,
@@ -581,7 +608,7 @@ class Scheduler:
             self._m_tps.set(self._m_tokens.value / wall)
         self.iterations += 1
         if self.spec_k:
-            self._bank_spec(out, acc, done)
+            self._bank_spec(out, acc, done, k=round_k)
             return done
         for s in list(self.active):
             st = self.active[s]
@@ -596,24 +623,28 @@ class Scheduler:
         return done
 
     def _bank_spec(self, out: np.ndarray, acc: np.ndarray,
-                   done: List[Completion]) -> None:
+                   done: List[Completion], k: Optional[int] = None) -> None:
         """Bank one verify round's output: the accepted draft prefix plus
         the bonus/corrected token at position acc, truncated by EOS and by
         the request's max_new_tokens budget (truncation discards tokens the
         non-spec path would never have produced, keeping the emitted stream
-        identical to sequential decoding)."""
+        identical to sequential decoding). ``k`` is the round's actual
+        width (adaptive-k may run below spec_k; accounting follows it)."""
+        k = self.spec_k if k is None else int(k)
         self.spec_rounds += 1
         n_active = len(self.active)
-        self.spec_draft_tokens += self.spec_k * n_active
-        self._m_spec_draft.inc(self.spec_k * n_active)
+        self.spec_draft_tokens += k * n_active
+        self._m_spec_draft.inc(k * n_active)
         round_accepted = 0
         for s in list(self.active):
             st = self.active[s]
             a = int(acc[s])
             st.steps += 1
-            st.spec_proposed += self.spec_k
+            st.spec_proposed += k
             st.spec_accepted += a
             round_accepted += a
+            if self.adaptive_k is not None:
+                self.adaptive_k.observe(st.request.id, a, k)
             banked = 0
             finished = None
             for i in range(a + 1):

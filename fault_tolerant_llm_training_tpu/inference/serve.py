@@ -11,15 +11,29 @@ reported unserved — then exits 0 with the ``[EXIT HANDLER]`` audit strings
 trainer uses for checkpoints applies unchanged to serving. Engine build
 (compilation, Orbax restore) runs with signal delivery blocked
 (``flag.deferred()``) for the same native-code EINTR reasons as train.py.
+
+``--follow`` turns the one-shot batch driver into the serving half of the
+CONTINUOUS DEPLOYMENT LOOP (deploy/): the process stays up after the
+initial prompt set, tails ``--request-file`` for new requests (JSONL, one
+request per appended line) and polls the trainer's ``published.json``
+between decode iterations. Each new publish is verified BEFORE load and
+hot-swapped into the running engine without dropping in-flight requests
+(deploy/reload.py has the swap state machine); a corrupt publish is
+rejected + audited and serving continues on current weights. The drain
+lifecycle is unchanged — SIGUSR1/SIGTERM finishes active requests and
+exits 0.
 """
 
 import argparse
+import json
 import os
 import sys
+import time
 
 from ..chaos import SERVE_FAULTS, ChaosInjector, parse_schedule
 from ..checkpoint.manager import update_checkpoint_age_gauge
 from ..data.tokenizer import load_tokenizer
+from ..deploy.reload import HotReloader, PointerWatcher
 from ..ft.signals import SignalFlag
 from ..models.configs import get_config
 from ..obs import events
@@ -43,9 +57,67 @@ from .engine import (
     enable_compilation_cache,
     restore_params,
 )
+from .sampler import AdaptiveK
 from .scheduler import Request, Scheduler
 
 _DEMO_PROMPT = "alpha bravo charlie delta echo"
+
+
+class _RequestFollower:
+    """Tail a JSONL request file (``--follow --request-file``).
+
+    Each line appended by the driver is one request:
+    ``{"id": "...", "prompt": "text", "max_new_tokens": 8, ...}`` —
+    missing knobs fall back to the serve flags. Only COMPLETE lines
+    (newline-terminated) are consumed, tracked by byte offset, so a
+    driver caught mid-append never yields a torn request."""
+
+    def __init__(self, path: str, tokenizer, args):
+        self.path = path
+        self.tokenizer = tokenizer
+        self.args = args
+        self.offset = 0
+        self.count = 0
+
+    def ingest(self, sched: Scheduler) -> int:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0
+        if size <= self.offset:
+            return 0
+        with open(self.path, "rb") as fh:
+            fh.seek(self.offset)
+            data = fh.read()
+        end = data.rfind(b"\n")
+        if end < 0:
+            return 0
+        chunk = data[:end + 1]
+        self.offset += len(chunk)
+        n = 0
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+                prompt = self.tokenizer.encode(str(d["prompt"]))
+            except (ValueError, KeyError, TypeError):
+                logger.warning(f"[SERVE] skipping malformed request line "
+                               f"{line!r}")
+                continue
+            rid = str(d.get("id", f"file{self.count}"))
+            self.count += 1
+            sched.submit(Request(
+                id=rid, prompt=prompt,
+                max_new_tokens=int(d.get("max_new_tokens",
+                                         self.args.max_new_tokens)),
+                temperature=float(d.get("temperature",
+                                        self.args.temperature)),
+                top_p=float(d.get("top_p", self.args.top_p)),
+                seed=int(d.get("seed", self.args.seed + self.count))))
+            n += 1
+        return n
 
 
 def get_serve_args(argv=None) -> argparse.Namespace:
@@ -154,7 +226,29 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                    help="fault schedule keyed by decode iteration "
                         "('step=<N>:sigusr1' / 'step=<N>:sigterm'; "
                         "chaos/schedule.py grammar) — delivers a real "
-                        "drain signal mid-decode")
+                        "drain signal mid-decode; 'step=<N>:reload_signal' "
+                        "(keyed by reload ordinal) lands a SIGUSR1 in the "
+                        "middle of the Nth hot weight swap")
+    p.add_argument("--follow", action="store_true",
+                   help="continuous-deployment mode: stay up after the "
+                        "initial prompts, tail --request-file for new "
+                        "requests and hot-reload each verified publish of "
+                        "published.json (deploy/) without dropping "
+                        "in-flight requests; SIGUSR1/SIGTERM still drains "
+                        "and exits 0")
+    p.add_argument("--poll-seconds", type=float, default=1.0,
+                   help="published.json / request-file poll interval while "
+                        "idle in --follow mode")
+    p.add_argument("--request-file", default="",
+                   help="JSONL file tailed for requests in --follow mode "
+                        "(one {'id','prompt',...} object per line; "
+                        "complete lines only)")
+    p.add_argument("--adaptive-spec-k", action="store_true",
+                   help="tune the speculative round width per request from "
+                        "live acceptance (sampler.AdaptiveK): a stale "
+                        "draft — e.g. right after a target-only hot swap — "
+                        "walks k toward 1 instead of burning --spec-k "
+                        "rejected proposals per round")
     return p.parse_args(argv)
 
 
@@ -196,6 +290,7 @@ def main(argv=None) -> None:
                    if args.prefill_buckets else None)
         spec_kwargs = {}
         draft_step_restored = None
+        draft_cfg = None
         if args.spec_k:
             if not (args.draft_checkpoint_path
                     and args.draft_checkpoint_job_id):
@@ -238,20 +333,52 @@ def main(argv=None) -> None:
         # a mid-prompt SIGUSR1/SIGTERM finishes the current chunk, frees the
         # request's blocks and reports it unserved — exact drain, any
         # prompt length.
+        adaptive = (AdaptiveK(args.spec_k)
+                    if args.spec_k and args.adaptive_spec_k else None)
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id),
-                          stop_check=lambda: flag.signum is not None)
-        prompts = (args.prompt or [_DEMO_PROMPT]) * args.repeat
+                          stop_check=lambda: flag.signum is not None,
+                          adaptive_k=adaptive)
+        prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
+                   ) * args.repeat
         for i, text in enumerate(prompts):
             sched.submit(Request(
                 id=f"req{i}", prompt=tokenizer.encode(text),
                 max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature, top_p=args.top_p,
                 seed=args.seed + i))
+        watcher = reloader = follower = None
+        if args.follow:
+            watcher = PointerWatcher(args.checkpoint_path)
+            reloader = HotReloader(engine, sched, cfg,
+                                   args.checkpoint_path,
+                                   draft_cfg=draft_cfg,
+                                   adaptive_k=adaptive, chaos=chaos)
+            if args.request_file:
+                follower = _RequestFollower(args.request_file, tokenizer,
+                                            args)
+            # catch up to the startup pointer: if it names a different
+            # step than we restored (e.g. the trainer published while the
+            # engine compiled), swap before taking traffic; if it names
+            # the serving step, the poll just primes the watcher's
+            # seen-key so the same publish is never re-offered
+            ptr0 = watcher.poll()
+            if ptr0 is not None and ptr0.step != engine.restored_step:
+                reloader.maybe_reload(ptr0)
 
     drained = False
-    while sched.pending():
+    while sched.pending() or (args.follow and not drained):
+        if args.follow and not drained:
+            if follower is not None:
+                follower.ingest(sched)
+            if not sched.pending() and flag.signum is None:
+                # idle follow tick: no requests in flight — absorb any
+                # publish now, then wait for work or a signal
+                if reloader.maybe_reload(watcher.poll()):
+                    continue  # a swap may race a fresh publish; re-poll
+                time.sleep(args.poll_seconds)
+                continue
         if chaos is not None:
             # keyed by decode iteration: the signal lands here and the
             # flag check just below begins the drain lifecycle mid-decode
@@ -268,6 +395,10 @@ def main(argv=None) -> None:
                 active=len(sched.active))
             sched.stop_admission()
             drained = True
+        if reloader is not None and not drained:
+            # between decode iterations — the in-flight round is finished,
+            # so this is exactly the swap's prefill-pause point
+            reloader.maybe_reload(watcher.poll())
         for c in sched.step():
             decoded = c.tokens[:-1] if (not args.no_eos and c.reason == "eos"
                                         ) else c.tokens

@@ -8,6 +8,11 @@ XenseCope/TensorBoard group device ops per step. scripts/profile_step.py
 used to do this ad hoc with its own start/stop + parser; both now live
 here (:func:`capture`, :func:`parse_trace`) so the CLI window, the script,
 and the tests share one implementation.
+
+:class:`AutoTraceWindow` (``--auto-trace``) is the reactive form: instead
+of a pre-chosen window it arms itself, once per run, when a step's wall
+time regresses past a multiple of the rolling median — capturing the
+slowdown the operator didn't know to schedule a window for.
 """
 
 import collections
@@ -16,7 +21,8 @@ import glob
 import gzip
 import json
 import re
-from typing import Optional, Tuple
+import statistics
+from typing import Callable, Optional, Tuple
 
 
 def parse_window(spec: str) -> Tuple[int, int]:
@@ -93,6 +99,96 @@ class TraceWindow:
                 if self.drain is not None:
                     self.drain()
                 jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self.active = False
+            self.done = True
+
+
+class AutoTraceWindow:
+    """Self-arming profiler window on step-time regression (``--auto-trace``).
+
+    ``--trace-steps`` needs the operator to know WHICH steps regressed —
+    useless for the transient cliffs (a thermal-throttled chip, a slow
+    storage burst, a noisy neighbor) that make long runs mysteriously
+    slow after the fact. This watcher keeps a rolling window of recent
+    step wall times and, when one step exceeds ``threshold`` times the
+    rolling MEDIAN (robust against the very outliers it hunts), arms a
+    bounded ``jax.profiler`` capture for the next ``capture_steps`` steps.
+    It fires at most ONCE per run — the point is a post-mortem artifact,
+    not a profiler left hot — and the trainer audits the arm
+    (``[TRACE]``) so the receipt says exactly which step tripped it and
+    where the trace landed.
+
+    ``profiler_start``/``profiler_stop`` are injectable for tests; the
+    defaults call ``jax.profiler`` lazily like :class:`TraceWindow`.
+    """
+
+    def __init__(self, trace_dir: str, threshold: float = 2.0,
+                 history: int = 32, min_samples: int = 8,
+                 capture_steps: int = 4,
+                 profiler_start: Optional[Callable[[str], None]] = None,
+                 profiler_stop: Optional[Callable[[], None]] = None):
+        if threshold <= 1.0:
+            raise ValueError(f"threshold must be > 1, got {threshold}")
+        self.trace_dir = trace_dir
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self.capture_steps = int(capture_steps)
+        self._times = collections.deque(maxlen=int(history))
+        self._start = profiler_start
+        self._stop = profiler_stop
+        self.active = False
+        self.done = False
+        self.trigger_step: Optional[int] = None
+        self.ratio = 0.0
+        self._captured = 0
+
+    def _profiler_start(self) -> None:
+        if self._start is not None:
+            self._start(self.trace_dir)
+            return
+        import jax
+
+        jax.profiler.start_trace(self.trace_dir)
+
+    def _profiler_stop(self) -> None:
+        if self._stop is not None:
+            self._stop()
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+
+    def observe(self, step: int, seconds: float) -> Optional[float]:
+        """Feed one finished step's wall time. Returns the regression
+        ratio when THIS sample arms the capture, else None (the trainer
+        audits on a non-None return)."""
+        if self.active:
+            self._captured += 1
+            if self._captured >= self.capture_steps:
+                self._profiler_stop()
+                self.active = False
+                self.done = True
+            return None
+        if self.done:
+            return None
+        if len(self._times) >= self.min_samples:
+            med = statistics.median(self._times)
+            if med > 0 and seconds > self.threshold * med:
+                self.ratio = seconds / med
+                self.trigger_step = int(step)
+                self._profiler_start()
+                self.active = True
+                return self.ratio
+        self._times.append(float(seconds))
+        return None
+
+    def close(self) -> None:
+        """Stop a still-armed capture (loop exited inside the window)."""
+        if self.active:
+            try:
+                self._profiler_stop()
             except Exception:
                 pass
             self.active = False

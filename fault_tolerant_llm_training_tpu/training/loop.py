@@ -41,9 +41,10 @@ from ..ft import multihost
 from ..ft.multihost import PeerHostError, barrier
 from ..ft.signals import SignalFlag, TrainingSignal
 from ..models import Transformer, get_config
+from ..deploy.publish import Publisher
 from ..obs import events
 from ..obs.registry import REGISTRY
-from ..obs.trace import TraceWindow
+from ..obs.trace import AutoTraceWindow, TraceWindow
 from ..parallel.mesh import make_mesh, use_mesh
 from ..parallel.sharding import batch_pspec, param_pspecs
 from ..training.state import TrainState
@@ -56,6 +57,7 @@ from ..utils.logging import (
     AUDIT_RESUME_FMT,
     AUDIT_START,
     AUDIT_STEP_FMT,
+    AUDIT_TRACE_AUTO_FMT,
     logger,
 )
 from ..utils.metrics import (
@@ -355,8 +357,18 @@ class Trainer:
         # reference accumulates one .ckpt per preemption.
         self._save_job_id = self._job_id
         self.ckpt_mngr = CheckpointManager(cfg.checkpoint_path,
-                                           self._save_job_id)
+                                           self._save_job_id,
+                                           max_to_keep=cfg.checkpoint_keep)
         self._log_checkpoint_budget()
+        # Deployment pointer (--publish, deploy/publish.py): host 0 commits
+        # published.json after each periodic save's integrity sweep. The
+        # serving watcher (deploy/reload.py) verifies the manifest before
+        # it ever loads, so a torn or corrupted publish cannot take down
+        # serving — publishing is fire-and-forget from the trainer's side.
+        self._publisher = None
+        if cfg.publish and jax.process_index() == 0:
+            self._publisher = Publisher(cfg.checkpoint_path,
+                                        self._save_job_id, chaos=self.chaos)
 
         self.batch_sharding = NamedSharding(self.mesh, batch_pspec())
         self._jit_step = jax.jit(
@@ -420,21 +432,38 @@ class Trainer:
             logger.info(f"Trace window | steps "
                         f"{self._trace.start_step}:{self._trace.stop_step} "
                         f"-> {trace_dir}")
+        # Reactive capture (--auto-trace, obs/trace.py AutoTraceWindow):
+        # arms once per run when a step's wall regresses past 2x the
+        # rolling median. Mutually exclusive with the explicit window —
+        # one profiler owner at a time (utils/config.py).
+        self._auto_trace = None
+        if cfg.auto_trace and not cfg.trace_steps:
+            trace_dir = cfg.profile_dir or os.path.join(
+                cfg.checkpoint_path or "/tmp",
+                f"traces_{self._job_id}")
+            self._auto_trace = AutoTraceWindow(trace_dir)
+            logger.info(f"Auto-trace | armed (2x median) -> {trace_dir}")
 
-        # /metrics endpoint + per-host heartbeats (obs/prometheus.py).
+        # /metrics endpoint (obs/prometheus.py), gated on --metrics-port.
         self._metrics_server = None
         self._heartbeat = None
         if cfg.metrics_port:
-            from ..obs.prometheus import HeartbeatThread, MetricsServer
+            from ..obs.prometheus import MetricsServer
 
             self._metrics_server = MetricsServer(port=cfg.metrics_port)
             port = self._metrics_server.start()
             logger.info(f"Metrics | serving /metrics on port {port}")
-            if cfg.heartbeat_seconds > 0:
-                self._heartbeat = HeartbeatThread(
-                    lambda: self.training_step,
-                    interval_seconds=cfg.heartbeat_seconds)
-                self._heartbeat.start()
+        # Per-host heartbeats run regardless of the scrape endpoint: the
+        # age gauges feed the flight recorder and the straggler analysis,
+        # and a host without a scraper still publishes its beat for every
+        # OTHER host's gauges (utils/config.py heartbeat_seconds).
+        if cfg.heartbeat_seconds > 0:
+            from ..obs.prometheus import HeartbeatThread
+
+            self._heartbeat = HeartbeatThread(
+                lambda: self.training_step,
+                interval_seconds=cfg.heartbeat_seconds)
+            self._heartbeat.start()
 
         # --- held-out evaluation (no reference counterpart; SURVEY §5.5
         # notes training loss is the reference's only metric) ---
@@ -603,9 +632,12 @@ class Trainer:
             events.emit("compile", **self._compile_event)
             self._compile_event = None
 
-        if cfg.profile_dir and not cfg.trace_steps:
+        whole_run_trace = (cfg.profile_dir and not cfg.trace_steps
+                           and self._auto_trace is None)
+        if whole_run_trace:
             # bare --profile-dir keeps its whole-run capture; --trace-steps
-            # supersedes it with the bounded window (obs/trace.py)
+            # and --auto-trace supersede it with a bounded window
+            # (obs/trace.py) — one profiler owner at a time
             jax.profiler.start_trace(cfg.profile_dir)
         try:
             self._loop()
@@ -620,10 +652,12 @@ class Trainer:
                 multihost.announce_local_error(self._dispatched)
             raise
         finally:
-            if cfg.profile_dir and not cfg.trace_steps:
+            if whole_run_trace:
                 jax.profiler.stop_trace()
             if self._trace is not None:
                 self._trace.close()
+            if self._auto_trace is not None:
+                self._auto_trace.close()
 
     def _loop(self) -> None:
         cfg = self.cfg
@@ -713,7 +747,16 @@ class Trainer:
                 # preemption, not during it). Later saves are async.
                 first = not self._budget_observed
                 self._budget_observed = True
-                self.save_checkpoint(wait=first, stop_prefetch=False)
+                saved = self.save_checkpoint(wait=first, stop_prefetch=False)
+                if self._publisher is not None:
+                    # The pointer must never point at a step without its
+                    # integrity manifest (the watcher would reject it), so
+                    # an async save drains before publishing. That trades
+                    # the async overlap for a durable deployment point —
+                    # the cadence that wants both is a higher
+                    # --checkpoint-frequency, not a torn publish.
+                    self.ckpt_mngr.wait_until_finished()
+                    self._publisher.publish(saved)
             if (self._compiled_eval is not None
                     and self.training_step % cfg.eval_frequency == 0):
                 self._evaluate()
@@ -847,7 +890,17 @@ class Trainer:
         self.throughput.step()
         now = time.perf_counter()
         if self._last_consume_t is not None:
-            self._m_step_time.observe(now - self._last_consume_t)
+            dt = now - self._last_consume_t
+            self._m_step_time.observe(dt)
+            if self._auto_trace is not None:
+                ratio = self._auto_trace.observe(step_no, dt)
+                if ratio is not None:
+                    events.emit_audit(
+                        logger,
+                        AUDIT_TRACE_AUTO_FMT.format(ratio=ratio,
+                                                    step=step_no),
+                        "trace_auto", step=step_no, ratio=ratio,
+                        trace_dir=self._auto_trace.trace_dir)
         self._last_consume_t = now
         self.last_loss = loss
         self._m_loss.set(loss)
@@ -1055,6 +1108,8 @@ class Trainer:
         self.ckpt_mngr.close()
         if self._trace is not None:
             self._trace.close()
+        if self._auto_trace is not None:
+            self._auto_trace.close()
         if self._heartbeat is not None:
             self._heartbeat.stop()
             self._heartbeat = None

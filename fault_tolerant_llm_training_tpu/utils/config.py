@@ -86,6 +86,11 @@ class TrainConfig:
     pretokenize_dir: str = ""  # cache dir for one-time tokenization (map path)
     legacy_packing: bool = True  # reproduce reference packing quirks (dataset.py:78,93)
     checkpoint_frequency: int = 0  # 0 = fault-triggered only (reference behavior)
+    checkpoint_keep: int = 2  # Orbax max_to_keep (older steps GC'd)
+    # Deployment loop (deploy/): after each periodic save's integrity
+    # manifest commits, host 0 atomically points published.json at the
+    # step so a --follow serving process hot-reloads it.
+    publish: bool = False
     eval_dataset: str = ""  # held-out parquet; empty = use --dataset
     eval_frequency: int = 0  # evaluate every N steps (0 = off)
     eval_batches: int = 8  # batches per evaluation pass
@@ -120,6 +125,11 @@ class TrainConfig:
     # Unlike bare --profile-dir, the capture is bounded — usable mid-run on
     # long jobs.
     trace_steps: str = ""
+    # Reactive profiler window (obs/trace.py AutoTraceWindow): arm a
+    # bounded capture automatically, once per run, when a step's wall
+    # time exceeds 2x the rolling median. Ignored when --trace-steps is
+    # set (one profiler owner at a time).
+    auto_trace: bool = False
     # Structured JSONL flight-recorder output dir (obs/events.py); "" =
     # <checkpoint-path>/events, "off" = disabled. One events_<jobid>.jsonl
     # per job; scripts/goodput_report.py stitches them across restarts.
@@ -128,8 +138,9 @@ class TrainConfig:
     # text format, obs/prometheus.py); 0 = off.
     metrics_port: int = 0
     # Per-host heartbeat publish interval through the ft/multihost.py KV
-    # store (exported as ftl_host_heartbeat_* gauges); 0 = off. Only
-    # active when --metrics-port is set (the gauges need a scraper).
+    # store (exported as ftl_host_heartbeat_* gauges); 0 = off. Every
+    # host publishes and sweeps regardless of --metrics-port — the age
+    # gauges also feed the flight recorder, not just a scraper.
     heartbeat_seconds: float = 10.0
     # JAX persistent compilation cache directory (utils/compile_cache.py);
     # "" = off. A warm cache turns the restart-after-preemption compile
@@ -313,6 +324,16 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                         help="Fix the reference packing quirks (buffer discard / doc re-read)")
     parser.add_argument("--checkpoint-frequency", type=int, default=0,
                         help="Save every N steps; 0 = fault-triggered only (reference behavior)")
+    parser.add_argument("--checkpoint-keep", type=int, default=2,
+                        help="Orbax max_to_keep: retained checkpoint steps "
+                             "(older ones are garbage-collected). Raise it "
+                             "when --publish serves older steps (a "
+                             "published step must outlive the pointer)")
+    parser.add_argument("--publish", action="store_true",
+                        help="After each periodic save's integrity manifest "
+                             "commits, atomically point published.json at "
+                             "the step (deploy/publish.py, host 0) so a "
+                             "serve.py --follow process hot-reloads it")
     parser.add_argument("--eval-dataset", type=str, default="",
                         help="Held-out parquet (file/dir/glob) for --eval-frequency; "
                              "empty = evaluate on --dataset")
@@ -351,6 +372,12 @@ def get_args(argv: Optional[list] = None) -> TrainConfig:
                              "through B inclusive, obs/trace.py); bounded, "
                              "so usable mid-run on long jobs. Output: "
                              "--profile-dir or <checkpoint-path>/traces")
+    parser.add_argument("--auto-trace", action="store_true",
+                        help="Arm a bounded profiler capture automatically "
+                             "(once per run) when a step's wall time "
+                             "regresses past 2x the rolling median "
+                             "(obs/trace.py AutoTraceWindow); ignored when "
+                             "--trace-steps is set")
     parser.add_argument("--event-log-dir", type=str, default="",
                         help="Flight-recorder JSONL dir (obs/events.py): "
                              "one events_<jobid>.jsonl per job, stitched "

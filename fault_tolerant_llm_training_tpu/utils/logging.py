@@ -82,9 +82,25 @@ AUDIT_KV_LEAK_FMT = ("[KV LEAK] {pool} pool: {leaked} block(s) leaked "
 # scripts/chaos_campaign.py and tests/test_chaos.py grep for, frozen in
 # tests/test_audit_contract.py like the rest. ---
 AUDIT_CHAOS_INJECT_FMT = "[CHAOS] Injected {fault} at step {step}"
+AUDIT_TRACE_AUTO_FMT = ("[TRACE] Step time regressed {ratio:.1f}x vs "
+                        "rolling median; capturing profiler window at "
+                        "step {step}")
 AUDIT_CKPT_VERIFY_FAILED_FMT = ("[CKPT VERIFY] Checkpoint step {step} "
                                 "failed integrity check: {detail}")
 AUDIT_CKPT_FALLBACK_FMT = ("[CKPT VERIFY] Falling back to checkpoint step "
                            "{step} (newest passing)")
 AUDIT_CKPT_PARTIAL_SKIPPED_FMT = ("[CKPT FINALIZE] Skipped partial "
                                   "checkpoint directory {name}")
+
+# --- Deployment-loop audit trail (deploy/publish.py, deploy/reload.py) —
+# the continuous train->serve loop's grep surface: publishes, hot weight
+# swaps and rejected (corrupt) publishes are asserted by
+# tests/test_deploy.py and scripts/chaos_campaign.py exactly like the
+# drain lifecycle above. ---
+AUDIT_PUBLISH_FMT = ("[DEPLOY] Published checkpoint step {step} "
+                     "(digest {digest})")
+AUDIT_RELOAD_FMT = ("[DEPLOY] Weights reloaded: step {old} -> {new} | "
+                    "{active} in-flight | swap {ms:.0f} ms")
+AUDIT_RELOAD_REJECTED_FMT = ("[DEPLOY] Publish of step {step} rejected: "
+                             "{detail}; serving continues on step "
+                             "{current}")

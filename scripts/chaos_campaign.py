@@ -28,6 +28,14 @@ periodic checkpoints every 5 steps):
   loader_stall 2 s prefetch-worker stall at step 15; the run completes
                with every one of its 30 full-precision losses bit-equal
                to the clean baseline's (no token replayed, none skipped)
+  deploy       continuous-deployment loop (deploy/): a publishing train
+               run commits steps 5..30; a live serve.py --follow process
+               starts on a rolled-back publish of step 10, absorbs hot
+               swaps to 20 and 30 WITHOUT dropping its in-flight
+               requests, rejects a chaos-corrupted publish of step 15
+               (verify-before-load) while continuing to serve on 30, and
+               its post-swap output streams bit-match a fresh serve
+               restored directly at step 30
 
 Bit-exactness evidence: full-precision ``loss`` floats from the step
 events, compared against a clean baseline run with the same seed; for
@@ -49,6 +57,7 @@ import shutil
 import signal as _signal
 import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -60,7 +69,7 @@ from fault_tolerant_llm_training_tpu.obs.goodput import (  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
-             "loader_stall")
+             "loader_stall", "deploy")
 # Known container-level post-restore native crash codes (SIGABRT/SIGSEGV,
 # as rc or negative signal): the resumed process dies after the restore
 # audits are flushed. Survival is then judged on the audit trail.
@@ -75,6 +84,8 @@ def _env():
     env["JAX_COMPILATION_CACHE_DIR"] = env.get(
         "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_compile_cache")
     env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    # serve.py and deploy/publish.py run as -m modules
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return env
 
 
@@ -130,6 +141,74 @@ def _run(argv, job_id: str, timeout: int = 300):
             out, _ = proc.communicate()
         return 124, out
     return proc.returncode, out
+
+
+class _ServeDriver:
+    """Background serve.py with line tailing.
+
+    The deploy scenario interleaves publishes with a LIVE decode stream,
+    so the serve process's stdout is pumped on a thread and the driver
+    blocks on specific audit lines (``wait_for``) to sequence its moves —
+    the same reader-thread pattern the serve e2e tests use."""
+
+    def __init__(self, argv, job_id: str):
+        env = _env()
+        env["SLURM_JOB_ID"] = job_id
+        self.proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True,
+                                     env=env)
+        self.lines = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line.rstrip("\n"))
+
+    def wait_for(self, pattern: str, timeout: float = 240.0):
+        """Block until any output line so far matches ``pattern``;
+        returns the re.Match or None on timeout / process exit. Every
+        call scans the whole buffer (the scenario's patterns are all
+        distinct), so out-of-order completions are never skipped."""
+        rx = re.compile(pattern)
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                snapshot = list(self.lines)
+            for line in snapshot:
+                m = rx.search(line)
+                if m:
+                    return m
+            if time.monotonic() >= deadline:
+                return None
+            if (self.proc.poll() is not None
+                    and len(snapshot) == len(self.lines)):
+                return None
+            time.sleep(0.05)
+
+    def output(self) -> str:
+        with self._lock:
+            return "\n".join(self.lines)
+
+    def finish(self, timeout: int = 90) -> int:
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        self._thread.join(timeout=5)
+        return self.proc.returncode
+
+
+def _serve_argv(ckpts: str, job_id: str, extra):
+    return [sys.executable, "-m",
+            "fault_tolerant_llm_training_tpu.inference.serve",
+            "--checkpoint-path", ckpts, "--checkpoint-job-id", job_id,
+            "--model", "tiny", "--tokenizer-name-or-path", "byte",
+            "--slots", "2", "--max-len", "256", "--no-eos",
+            "--log-frequency", "2"] + list(extra)
 
 
 def _event_losses(events_dir: str, job_id: str) -> dict:
@@ -320,6 +399,166 @@ def run_scenario(name: str, work: str, parquet: str, seed: int,
     return res
 
 
+def run_deploy_scenario(work: str, parquet: str, seed: int) -> Result:
+    """Deployment-loop scenario: train-with-publish, then a live serve
+    absorbs 2 hot swaps with requests in flight, rejects a corrupt
+    publish, and bit-matches a fresh restore (module docstring)."""
+    from fault_tolerant_llm_training_tpu.deploy.publish import (
+        Publisher,
+        read_pointer,
+    )
+
+    res = Result("deploy")
+    ckpts = os.path.join(work, "deploy", "ckpts")
+    events_dir = os.path.join(ckpts, "events")
+    os.makedirs(ckpts, exist_ok=True)
+    job = "deploy_a"
+
+    # 1. publishing train run: every periodic manifest commit (steps
+    # 5..30, keep 6 so none is GC'd) moves published.json, ending at 30
+    rc, out = _run(_train_argv(parquet, ckpts, seed,
+                               **{"--checkpoint-frequency": "5",
+                                  "--checkpoint-keep": "6",
+                                  "--publish": ""}), job)
+    res.check(rc == 0, f"publishing train run exits 0 (got {rc})")
+    res.check("[DEPLOY] Published checkpoint step 30" in out,
+              "trainer published the final periodic save")
+    ptr = read_pointer(ckpts)
+    res.check(ptr is not None and ptr.step == 30,
+              "published.json points at step 30 after training")
+    if not res.survived:
+        return res
+
+    # 2. roll the pointer BACK to step 10 through the operator CLI so the
+    # serve under test starts two publishes behind the trainer's tip
+    rc, _ = _run([sys.executable, "-m",
+                  "fault_tolerant_llm_training_tpu.deploy.publish",
+                  "--checkpoint-path", ckpts, "--job-id", job,
+                  "--step", "10"], "deploy_pub10")
+    ptr = read_pointer(ckpts)
+    res.check(rc == 0 and ptr is not None and ptr.step == 10,
+              "publish CLI re-pointed the deployment at step 10")
+
+    # 3. live serve on the step-10 publish, tailing a request file
+    reqs = os.path.join(work, "deploy", "requests.jsonl")
+    open(reqs, "w").close()
+    serve_events = os.path.join(work, "deploy", "serve_events.jsonl")
+    drv = _ServeDriver(_serve_argv(ckpts, job, [
+        "--step", "10", "--seed", str(seed), "--follow",
+        "--poll-seconds", "0.2", "--request-file", reqs,
+        "--event-log", serve_events]), "deploy_serve")
+    outputs = {}
+    w3 = [("w3a", "india juliett kilo lima"),
+          ("w3b", "mike november oscar papa quebec")]
+    try:
+        res.check(drv.wait_for(r"Serving ready \| model tiny \| "
+                               r"checkpoint step 10",
+                               timeout=420) is not None,
+                  "serve restored the published step-10 checkpoint")
+
+        # wave 1: long greedy requests that stay in flight across BOTH
+        # swaps (the publishes below land a few decode iterations in)
+        with open(reqs, "a") as fh:
+            for rid in ("w1a", "w1b"):
+                fh.write(json.dumps({
+                    "id": rid,
+                    "prompt": "alpha bravo charlie delta echo foxtrot "
+                              "golf hotel",
+                    "max_new_tokens": 96, "temperature": 0.0}) + "\n")
+        res.check(drv.wait_for(r"Serve step: \d+ \| Active: [12]")
+                  is not None, "wave-1 requests admitted and decoding")
+
+        publisher = Publisher(ckpts, job)
+        for old, new in ((10, 20), (20, 30)):
+            publisher.publish(new)
+            m = drv.wait_for(rf"\[DEPLOY\] Weights reloaded: "
+                             rf"step {old} -> {new} \| (\d+) in-flight")
+            res.check(m is not None, f"publish of step {new} hot-swapped "
+                                     f"into the running engine")
+            res.check(m is not None and int(m.group(1)) >= 1,
+                      f"swap {old}->{new} carried in-flight requests "
+                      f"(active={m.group(1) if m else '?'})")
+
+        # the swaps must not have dropped or truncated wave 1
+        for rid in ("w1a", "w1b"):
+            m = drv.wait_for(rf"Request {rid} done \| length \| "
+                             rf"prompt \d+ tok \| generated (\d+) tok")
+            res.check(m is not None and int(m.group(1)) == 96,
+                      f"{rid} ran to its full 96 tokens across both swaps")
+
+        # 4. corrupt publish: chaos flips a committed byte of step 15
+        # AFTER the pointer moves; verify-before-load must reject it
+        rc, out = _run([sys.executable, "-m",
+                        "fault_tolerant_llm_training_tpu.deploy.publish",
+                        "--checkpoint-path", ckpts, "--job-id", job,
+                        "--step", "15",
+                        "--chaos", "step=15:publish_corrupt",
+                        "--seed", str(seed)], "deploy_pub15")
+        res.check(rc == 0 and
+                  "[CHAOS] Injected publish_corrupt at step 15" in out,
+                  "chaos-corrupted publish of step 15 committed")
+        res.check(drv.wait_for(r"\[DEPLOY\] Publish of step 15 rejected: "
+                               r".*; serving continues on step 30")
+                  is not None,
+                  "corrupt publish rejected before load; serving "
+                  "continues on step 30")
+
+        # wave 3: decoded WHOLLY on the swapped step-30 weights — these
+        # output reprs are the bit-match reference
+        with open(reqs, "a") as fh:
+            for rid, prompt in w3:
+                fh.write(json.dumps({"id": rid, "prompt": prompt,
+                                     "max_new_tokens": 24,
+                                     "temperature": 0.0}) + "\n")
+        for rid, _ in w3:
+            m = drv.wait_for(rf"Request {rid} output: (.+)$")
+            res.check(m is not None,
+                      f"{rid} completed on the swapped step-30 weights")
+            if m is not None:
+                outputs[rid] = m.group(1)
+
+        # drain exactly like training: SIGUSR1 finishes in-flight, exit 0
+        drv.proc.send_signal(_signal.SIGUSR1)
+        rc = drv.finish()
+    finally:
+        if drv.proc.poll() is None:
+            drv.proc.kill()
+            drv.finish(timeout=10)
+    out = drv.output()
+    res.check(rc == 0, f"serve drained and exited 0 (got {rc})")
+    res.check("[EXIT HANDLER] Drained;" in out, "drain audited")
+
+    # flight recorder agrees with the log lines
+    kinds = []
+    if os.path.isfile(serve_events):
+        with open(serve_events) as fh:
+            for line in fh:
+                try:
+                    kinds.append(json.loads(line).get("kind"))
+                except json.JSONDecodeError:
+                    pass
+    res.check(kinds.count("weights_reload") == 2 and
+              kinds.count("weights_reload_rejected") == 1,
+              "flight recorder: exactly 2 swaps + 1 rejection")
+
+    # 5. fresh serve restored directly at step 30, same prompts/knobs:
+    # greedy streams must be bit-identical to the hot-swapped process's
+    argv = _serve_argv(ckpts, job, ["--step", "30", "--seed", str(seed),
+                                    "--max-new-tokens", "24"])
+    for _, prompt in w3:
+        argv += ["--prompt", prompt]
+    rc, out2 = _run(argv, "deploy_fresh", timeout=600)
+    res.check(rc == 0, f"fresh step-30 serve exits 0 (got {rc})")
+    fresh = dict(re.findall(r"Request (req\d+) output: (.+)", out2))
+    res.check(len(outputs) == 2 and
+              fresh.get("req0") == outputs.get("w3a") and
+              fresh.get("req1") == outputs.get("w3b"),
+              "post-swap streams bit-identical to a fresh restore of "
+              "step 30")
+    _stitch_scenario(res, events_dir)
+    return res
+
+
 def format_report(results, seed: int, wall: float, extra_notes) -> str:
     lines = []
     lines.append("Chaos survival campaign")
@@ -393,8 +632,11 @@ def main(argv=None) -> int:
     results = []
     for name in wanted:
         print(f"== scenario: {name}")
-        res = run_scenario(name, work, parquet, args.seed, baseline_losses,
-                           sbatch=args.sbatch)
+        if name == "deploy":
+            res = run_deploy_scenario(work, parquet, args.seed)
+        else:
+            res = run_scenario(name, work, parquet, args.seed,
+                               baseline_losses, sbatch=args.sbatch)
         results.append(res)
         print(f"   -> {'survived' if res.survived else 'FAILED'}")
 

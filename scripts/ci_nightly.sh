@@ -4,11 +4,15 @@
 #
 # 1. slow-marked suite — chaos end-to-end through train.py, the
 #    speculative and prefix-cache compiled stream-equality tests;
-# 2. chaos survival campaign — all five fault classes under the
-#    fake_slurm shim, with the per-class survival verdicts diffed
-#    against the committed receipt logs/chaos_campaign.txt (goodput and
-#    MTTR columns are wall-clock noisy, so only class + survived are
-#    pinned; a class flipping to "no" fails the night);
+# 2. chaos survival campaign — the five fault classes under the
+#    fake_slurm shim plus the deploy scenario (publish -> hot reload ->
+#    verify drill: a live serve absorbs two publishes with requests in
+#    flight, rejects a chaos-corrupted one, bit-matches a fresh
+#    restore), with the per-class survival verdicts diffed against the
+#    committed receipt logs/chaos_campaign.txt (goodput and MTTR
+#    columns are wall-clock noisy, so only class + survived are pinned;
+#    a class flipping to "no" fails the night) and the deploy drill's
+#    key checks pinned line-for-line;
 # 3. shared_prefix decode bench — re-runs the prefix-caching scenario
 #    and holds it to the committed BENCH_decode_prefix_cpu.json
 #    acceptance bars: cached N=8 prefill <= 2x N=1 and
@@ -29,7 +33,7 @@ echo "== slow-marked suite"
 python -m pytest tests/ -q -m slow --continue-on-collection-errors \
     -p no:cacheprovider -p no:randomly
 
-echo "== chaos survival campaign (5 classes)"
+echo "== chaos survival campaign (5 fault classes + deploy drill)"
 export FAKE_SLURM_DIR="$WORK/slurm"
 cat > "$WORK/requeue.sh" <<EOF
 #!/bin/bash
@@ -52,6 +56,22 @@ if ! diff -u "$WORK/want.survival" "$WORK/got.survival"; then
     exit 1
 fi
 echo "ok: survival verdicts match the committed receipt"
+
+# the deploy drill's substance, not just its one-word verdict: both
+# hot swaps carried live requests, the corrupt publish was rejected,
+# and the post-swap streams bit-matched a fresh restore
+for want in \
+    "ok: swap 10->20 carried in-flight requests" \
+    "ok: swap 20->30 carried in-flight requests" \
+    "ok: corrupt publish rejected before load; serving continues on step 30" \
+    "ok: post-swap streams bit-identical to a fresh restore of step 30"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: deploy drill check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: deploy drill (publish -> hot reload -> verify) checks present"
 
 echo "== shared_prefix bench vs committed receipt"
 python scripts/decode_bench.py --scenario shared_prefix \

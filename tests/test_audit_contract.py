@@ -81,6 +81,17 @@ FROZEN = {
         "(newest passing)",
     "AUDIT_CKPT_PARTIAL_SKIPPED_FMT":
         "[CKPT FINALIZE] Skipped partial checkpoint directory {name}",
+    "AUDIT_TRACE_AUTO_FMT":
+        "[TRACE] Step time regressed {ratio:.1f}x vs rolling median; "
+        "capturing profiler window at step {step}",
+    "AUDIT_PUBLISH_FMT":
+        "[DEPLOY] Published checkpoint step {step} (digest {digest})",
+    "AUDIT_RELOAD_FMT":
+        "[DEPLOY] Weights reloaded: step {old} -> {new} | {active} "
+        "in-flight | swap {ms:.0f} ms",
+    "AUDIT_RELOAD_REJECTED_FMT":
+        "[DEPLOY] Publish of step {step} rejected: {detail}; serving "
+        "continues on step {current}",
 }
 
 

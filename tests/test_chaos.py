@@ -114,6 +114,68 @@ def test_bad_json_schedules_raise(tmp_path):
         parse_schedule("@" + str(bad_entry))
 
 
+def test_parse_time_and_prob_triggers():
+    entries = parse_schedule("t=30s:sigterm;p=0.1:kv_delay=250ms;"
+                             "step=5:sigusr1")
+    by_fault = {e.fault: e for e in entries}
+    assert (by_fault["sigterm"].trigger, by_fault["sigterm"].when) == \
+        ("time", 30.0)
+    assert (by_fault["kv_delay"].trigger, by_fault["kv_delay"].when) == \
+        ("prob", 0.1)
+    assert by_fault["kv_delay"].arg == 0.25
+    assert (by_fault["sigusr1"].trigger, by_fault["sigusr1"].step) == \
+        ("step", 5)
+
+
+@pytest.mark.parametrize("spec", [
+    "p=0:sigusr1",          # probability must be > 0
+    "p=1.5:sigusr1",        # probability must be <= 1
+    "p=maybe:sigusr1",      # unparseable probability
+    "t=fast:sigusr1",       # unparseable duration
+    "when=5:sigusr1",       # unknown trigger key
+])
+def test_parse_bad_trigger_specs_raise(spec):
+    with pytest.raises(ValueError):
+        parse_schedule(spec)
+
+
+def test_parse_json_time_and_prob_triggers(tmp_path):
+    path = tmp_path / "sched.json"
+    path.write_text(json.dumps([
+        {"t": "2s", "fault": "sigterm"},
+        {"p": 0.5, "fault": "kv_delay"},
+    ]))
+    entries = parse_schedule(str(path))
+    assert [(e.trigger, e.when) for e in entries] == [("prob", 0.5),
+                                                      ("time", 2.0)]
+    # exactly one trigger key per entry
+    path.write_text(json.dumps([{"step": 3, "p": 0.5, "fault": "sigusr1"}]))
+    with pytest.raises(ValueError, match="exactly one"):
+        parse_schedule(str(path))
+
+
+def test_time_trigger_fires_once_after_elapsed():
+    inj = ChaosInjector(parse_schedule("t=50ms:loader_stall=0s"), seed=0)
+    inj.on_batch(0)
+    assert not inj.entries[0].fired, "must not fire before the elapse"
+    time.sleep(0.06)
+    inj.on_batch(1)
+    assert inj.entries[0].fired
+    kinds = [e["kind"] for e in events_mod._RECORDER.ring]
+    assert kinds.count("chaos_loader_stall") == 1
+    inj.on_batch(2)  # latched
+    assert kinds.count("chaos_loader_stall") == 1
+
+
+def test_prob_trigger_fires_and_latches():
+    inj = ChaosInjector(parse_schedule("p=1:loader_stall=0s"), seed=0)
+    inj.on_batch(0)  # p=1.0: first visit fires
+    assert inj.entries[0].fired
+    inj.on_batch(1)
+    assert [e["kind"] for e in events_mod._RECORDER.ring].count(
+        "chaos_loader_stall") == 1
+
+
 def test_from_config_legacy_raise_error_alias():
     class Cfg:
         chaos = ""
@@ -261,6 +323,58 @@ def test_loader_stall_respects_resume_start_batch():
     assert elapsed < 5.0, "the pre-resume stall entry must not re-fire"
     assert not inj.entries[0].fired  # step 2 is in the past, stays pending
     assert inj.entries[1].fired
+
+
+def test_publish_corrupt_flips_byte_but_spares_manifest(tmp_path):
+    from fault_tolerant_llm_training_tpu.checkpoint.manager import (
+        MANIFEST_NAME,
+        verify_step_dir,
+        write_manifest,
+    )
+    from fault_tolerant_llm_training_tpu.utils.logging import logger
+
+    d = _make_step_dir(tmp_path, step=20)
+    write_manifest(str(d), 20)
+    manifest_before = (d / MANIFEST_NAME).read_bytes()
+
+    inj = ChaosInjector(parse_schedule("step=20:publish_corrupt"), seed=0)
+    assert inj.on_publish(str(d), 19, logger) is None  # not its step
+    corrupted = inj.on_publish(str(d), 20, logger)
+    assert corrupted is not None and str(d) in corrupted
+    # the manifest is spared — the corruption is what it must CATCH
+    assert (d / MANIFEST_NAME).read_bytes() == manifest_before
+    ok, detail = verify_step_dir(str(d))
+    assert not ok and "crc mismatch" in detail
+    assert inj.on_publish(str(d), 20, logger) is None  # latched
+    kinds = [e["kind"] for e in events_mod._RECORDER.ring]
+    assert kinds.count("chaos_publish_corrupt") == 2  # audit + detail event
+
+
+def test_reload_signal_fires_at_reload_ordinal():
+    from fault_tolerant_llm_training_tpu.ft.signals import SignalFlag
+
+    old_usr1 = signal.getsignal(signal.SIGUSR1)
+    old_term = signal.getsignal(signal.SIGTERM)
+    try:
+        flag = SignalFlag()
+        flag.register()
+        inj = ChaosInjector(parse_schedule("step=2:reload_signal"), seed=0)
+        inj.on_reload(1)
+        assert flag.signum is None
+        inj.on_reload(2)  # second swap: real SIGUSR1 mid-swap
+        assert flag.signum == signal.SIGUSR1
+        flag.signum = None
+        inj.on_reload(2)  # latched
+        assert flag.signum is None
+    finally:
+        signal.signal(signal.SIGUSR1, old_usr1)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def test_serve_faults_allow_reload_signal():
+    assert parse_schedule("step=1:reload_signal", allowed=SERVE_FAULTS)
+    with pytest.raises(ValueError, match="not supported in this context"):
+        parse_schedule("step=1:publish_corrupt", allowed=SERVE_FAULTS)
 
 
 # ------------------------------------------------------- integrity manifests

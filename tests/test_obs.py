@@ -338,6 +338,52 @@ def test_parse_window():
             parse_window(bad)
 
 
+def test_auto_trace_arms_once_on_regression_with_bounded_capture():
+    from fault_tolerant_llm_training_tpu.obs.trace import AutoTraceWindow
+
+    starts, stops = [], []
+    w = AutoTraceWindow("/tmp/t", threshold=2.0, min_samples=4,
+                        capture_steps=3, profiler_start=starts.append,
+                        profiler_stop=lambda: stops.append(True))
+    # warmup: too few samples — even a huge outlier cannot arm yet
+    for step in range(3):
+        assert w.observe(step, 100.0 if step == 2 else 0.1) is None
+    assert not starts
+    w2 = AutoTraceWindow("/tmp/t", threshold=2.0, min_samples=4,
+                         capture_steps=3, profiler_start=starts.append,
+                         profiler_stop=lambda: stops.append(True))
+    for step in range(6):
+        assert w2.observe(step, 0.1) is None
+    assert w2.observe(6, 0.15) is None, "below 2x median: no arm"
+    ratio = w2.observe(7, 0.5)  # 5x the rolling median
+    assert ratio == pytest.approx(5.0)
+    assert starts == ["/tmp/t"] and w2.active
+    assert w2.trigger_step == 7
+    for step in (8, 9, 10):
+        assert w2.observe(step, 0.5) is None  # captured steps don't re-arm
+    assert stops == [True] and w2.done and not w2.active
+    # once per run: a later, larger regression never re-arms
+    assert w2.observe(11, 9.0) is None
+    assert starts == ["/tmp/t"]
+
+
+def test_auto_trace_close_stops_armed_capture_and_validates():
+    from fault_tolerant_llm_training_tpu.obs.trace import AutoTraceWindow
+
+    with pytest.raises(ValueError):
+        AutoTraceWindow("/tmp/t", threshold=1.0)
+    stops = []
+    w = AutoTraceWindow("/tmp/t", min_samples=2, profiler_start=lambda d: None,
+                        profiler_stop=lambda: stops.append(True))
+    for step in range(4):
+        w.observe(step, 0.1)
+    assert w.observe(4, 1.0) is not None and w.active
+    w.close()  # loop exited inside the window
+    assert stops == [True] and w.done
+    w.close()  # idempotent
+    assert stops == [True]
+
+
 def test_profile_tool_reexports_shared_parser():
     import importlib.util
 
