@@ -98,6 +98,7 @@ from .kv_cache import (
 )
 from .sampler import (
     draft_key,
+    sample_slot_tokens,
     sample_token,
     sample_token_with_probs,
     slot_key,
@@ -147,14 +148,27 @@ class InferenceEngine:
                  draft_params=None, spec_k: int = 0,
                  draft_num_blocks: Optional[int] = None,
                  spec_verify_impl: str = "exact",
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 paged_kernel: str = "gather"):
         if kv_layout not in ("paged", "ring"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        if paged_kernel not in ("gather", "pallas"):
+            raise ValueError(
+                f"unknown paged_kernel {paged_kernel!r}: 'gather' "
+                f"(assemble blocks then run the ring kernel — the "
+                f"bit-exact reference) or 'pallas' (read pool blocks in "
+                f"place through the table, ops/paged_attention.py — equal "
+                f"within fp32 accumulation tolerance)")
+        if paged_kernel != "gather" and kv_layout != "paged":
+            raise ValueError("paged_kernel selection requires the paged "
+                             "KV layout")
+        self.paged_kernel = paged_kernel
         if cfg.layer_impl == "scan":
             params = unstack_layer_params(params, cfg.n_layers)
             cfg = cfg.replace(layer_impl="loop")
         # remat only pays under grad; serving is forward-only
-        self.cfg = cfg = cfg.replace(remat=False)
+        self.cfg = cfg = cfg.replace(remat=False,
+                                     paged_kernel=paged_kernel)
         self.mesh = mesh
         self.slots = slots
         self.max_len = max_len or cfg.seq_len
@@ -215,7 +229,11 @@ class InferenceEngine:
                 draft_params = unstack_layer_params(draft_params,
                                                     draft_cfg.n_layers)
                 draft_cfg = draft_cfg.replace(layer_impl="loop")
-            self.draft_cfg = draft_cfg = draft_cfg.replace(remat=False)
+            # the draft reads its pool through the same kernel: a spec
+            # round's S=1 micro-steps are exactly the decode shapes the
+            # in-place kernel serves
+            self.draft_cfg = draft_cfg = draft_cfg.replace(
+                remat=False, paged_kernel=self.paged_kernel)
             self.draft_num_blocks = (draft_num_blocks
                                      or slots * self.max_blocks_per_slot + 1)
             self.draft_model = Transformer(draft_cfg)
@@ -293,9 +311,8 @@ class InferenceEngine:
             {"params": params}, tokens[:, None], cache.k, cache.v,
             cache.lengths, method="forward_with_cache")
         last = logits[:, 0].astype(jnp.float32)
-        keys = jax.vmap(slot_key)(seeds, steps)
-        toks = jax.vmap(sample_token, in_axes=(0, 0, 0, 0, None))(
-            last, keys, temperature, top_p, self.top_k)
+        toks = sample_slot_tokens(last, seeds, steps, temperature, top_p,
+                                  self.top_k)
         lengths = cache.lengths + active.astype(jnp.int32)
         return KVCache(k=nk, v=nv, lengths=lengths), toks
 
@@ -330,17 +347,86 @@ class InferenceEngine:
                          temperature, top_p, seeds, steps):
         """One token for every slot through the block tables; inactive
         slots still run (static shapes) but their write diverts to the
-        null block and their lengths do not advance."""
+        null block and their lengths do not advance. The sampling
+        epilogue (sampler.py ``sample_slot_tokens``) is traced INTO the
+        program: logits -> temperature/top-k/top-p -> fold_in(seed, step)
+        sample all run device-side, so one dispatch ends in token ids and
+        the host syncs 4 bytes per slot instead of a (slots, V) logits
+        plane (the unfused comparison point is :meth:`decode_logits`)."""
         logits, (nk, nv) = self.model.apply(
             {"params": params}, tokens[:, None], cache.k, cache.v,
             cache.lengths, block_tables=block_tables,
             write_valid=active[:, None], method="forward_with_cache")
         last = logits[:, 0].astype(jnp.float32)
-        keys = jax.vmap(slot_key)(seeds, steps)
-        toks = jax.vmap(sample_token, in_axes=(0, 0, 0, 0, None))(
-            last, keys, temperature, top_p, self.top_k)
+        toks = sample_slot_tokens(last, seeds, steps, temperature, top_p,
+                                  self.top_k)
         lengths = cache.lengths + active.astype(jnp.int32)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), toks
+
+    def _paged_logits_fn(self, params, cache, block_tables, tokens, active):
+        """UNFUSED decode step: the identical forward, but the program
+        ends at the last-position fp32 logits — sampling is left to the
+        host (which then pays a full (slots, V) sync plus a second
+        dispatch for the sampling math). Kept as the bench's baseline so
+        the fused epilogue's win is measured, not asserted; streams
+        bit-match the fused path because both feed the same
+        ``sample_slot_tokens`` (sampler.py)."""
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens[:, None], cache.k, cache.v,
+            cache.lengths, block_tables=block_tables,
+            write_valid=active[:, None], method="forward_with_cache")
+        last = logits[:, 0].astype(jnp.float32)
+        lengths = cache.lengths + active.astype(jnp.int32)
+        return PagedKVCache(k=nk, v=nv, lengths=lengths), last
+
+    def _burst_decode_fn(self, n, params, cache, block_tables, tokens,
+                         active, temperature, top_p, seeds, steps):
+        """A BURST of n chained decode micro-steps in ONE compiled program
+        — the plain-decode sibling of the draft-k loop (``_draft_k_fn``):
+        a ``lax.fori_loop`` whose body is one S=1 forward + the fused
+        sampling epilogue, each iteration writing the fed token's KV
+        through the block tables and feeding its sample to the next. The
+        host pays ONE dispatch and ONE sync for n tokens instead of n of
+        each.
+
+        Bit-exactness: the body's op shapes are EXACTLY the single-step
+        decode program's (S=1 forward, same epilogue), so greedy burst
+        streams are bit-identical to n sequential ``decode_step`` calls
+        by construction — the same structural argument as the 'exact'
+        spec-verify mode (shape-dependent bf16 GEMM accumulation is why
+        identical shapes matter). Sampled slots match too: micro-step i
+        samples under ``slot_key(seed, steps + i)``, the key sequential
+        decode would use at that step.
+
+        EOS cannot stop the loop device-side (that would cost a sync per
+        micro-step, the thing being amortized): a slot that hits EOS
+        mid-burst keeps generating and the SCHEDULER truncates at banking
+        (``_bank_burst``), exactly like a rejected spec suffix — the
+        overshoot KV is stale pool content past the committed length,
+        masked and later overwritten. ``n`` is partial-bound before jit
+        (the ladder pattern of ``_compile_spec_pair``)."""
+        b = self.slots
+        offsets = cache.lengths
+        toks0 = jnp.zeros((b, n), jnp.int32)
+        valid = active[:, None]
+
+        def body(i, carry):
+            ck, cv, cur, toks = carry
+            logits, (nk, nv) = self.model.apply(
+                {"params": params}, cur[:, None], ck, cv, offsets + i,
+                block_tables=block_tables, write_valid=valid,
+                method="forward_with_cache")
+            last = logits[:, 0].astype(jnp.float32)
+            nxt = sample_slot_tokens(last, seeds, steps + i, temperature,
+                                     top_p, self.top_k)
+            toks = jax.lax.dynamic_update_slice_in_dim(
+                toks, nxt[:, None], i, axis=1)
+            return nk, nv, nxt, toks
+
+        ck, cv, _cur, toks = jax.lax.fori_loop(
+            0, n, body, (cache.k, cache.v, tokens, toks0))
+        lengths = jnp.where(active, offsets + n, cache.lengths)
+        return PagedKVCache(k=ck, v=cv, lengths=lengths), toks
 
     def _cow_fn(self, cache, src, dst):
         """Copy-on-write: duplicate pool block ``src`` into ``dst`` across
@@ -502,6 +588,12 @@ class InferenceEngine:
                 self._paged_decode_fn, donate_argnums=(1,)).lower(
                 p_abs, c_abs, tables_abs, slots_i, slots_b, slots_f,
                 slots_f, slots_i, slots_i).compile()
+            self._decode_logits = jax.jit(
+                self._paged_logits_fn, donate_argnums=(1,)).lower(
+                p_abs, c_abs, tables_abs, slots_i, slots_b).compile()
+            # burst programs compile on first use (decode_burst(n) —
+            # serving picks ONE n, so the ladder is usually one rung)
+            self._burst_programs = {}
             self._cow = jax.jit(
                 self._cow_fn, donate_argnums=(0,)).lower(
                 c_abs, scalar_i, scalar_i).compile()
@@ -567,6 +659,38 @@ class InferenceEngine:
             p_abs, c_abs, tables_abs, slots_i, dtoks_abs, dprobs_abs,
             slots_i, slots_b, slots_f, slots_f, slots_i, slots_i).compile()
         return draft, verify
+
+    def _compile_burst(self, n: int):
+        """AOT-compile the n-token burst decode program (``n`` bound with
+        functools.partial like the spec ladder's width)."""
+        p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
+        slots_i = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
+        slots_f = jax.ShapeDtypeStruct((self.slots,), jnp.float32)
+        slots_b = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
+        tables_abs = jax.ShapeDtypeStruct(
+            (self.slots, self.max_blocks_per_slot), jnp.int32)
+        return jax.jit(
+            functools.partial(self._burst_decode_fn, n),
+            donate_argnums=(1,)).lower(
+            p_abs, c_abs, tables_abs, slots_i, slots_b, slots_f, slots_f,
+            slots_i, slots_i).compile()
+
+    def _burst_program(self, n: int):
+        """The compiled n-token burst program, compiling on first use.
+        A serving process runs one configured burst width, so this is at
+        most a couple of one-time compiles (the scheduler's final partial
+        burst clamps n to the smallest remaining budget)."""
+        if self.kv_layout != "paged":
+            raise ValueError("burst decode requires the paged KV layout "
+                             "(the loop writes KV through block tables)")
+        n = int(n)
+        if not 1 <= n <= self.max_len:
+            raise ValueError(f"burst width {n} outside [1, {self.max_len}]")
+        prog = self._burst_programs.get(n)
+        if prog is None:
+            prog = self._compile_burst(n)
+            self._burst_programs[n] = prog
+        return prog
 
     def _spec_pair(self, k: int):
         """The compiled (draft-k, verify) pair for round width ``k``,
@@ -779,6 +903,49 @@ class InferenceEngine:
             return np.asarray(toks)
         self.cache, toks = self._decode(
             self.params, self.cache,
+            np.asarray(tokens, np.int32), np.asarray(active, bool),
+            np.asarray(temperature, np.float32),
+            np.asarray(top_p, np.float32),
+            np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
+        return np.asarray(toks)
+
+    def decode_logits(self, tokens, active, block_tables=None) -> np.ndarray:
+        """UNFUSED decode iteration: run the forward, sync the (slots, V)
+        fp32 logits to the host, sample nothing. The caller samples with
+        sampler.py ``sample_slot_tokens`` — same function the fused
+        programs trace — which is what pins the fused/unfused stream
+        bit-match the bench asserts. Paged layout only (it exists as the
+        fused epilogue's measured baseline)."""
+        if self.kv_layout != "paged":
+            raise ValueError("decode_logits requires the paged KV layout")
+        if block_tables is None:
+            raise ValueError("paged decode requires block_tables")
+        self.cache, logits = self._decode_logits(
+            self.params, self.cache, np.asarray(block_tables, np.int32),
+            np.asarray(tokens, np.int32), np.asarray(active, bool))
+        return np.asarray(logits)
+
+    def decode_burst(self, tokens, active, temperature, top_p, seeds, steps,
+                     n, block_tables=None) -> np.ndarray:
+        """A burst of ``n`` decode iterations in ONE dispatch + ONE host
+        sync; returns (slots, n) token ids. Greedy streams are bit-equal
+        to ``n`` sequential :meth:`decode_step` calls and sampled slots
+        share their PRNG schedule (``_burst_decode_fn`` documents why);
+        EOS/budget truncation of the overshoot is the scheduler's job
+        (``Scheduler._bank_burst``). ``n == 1`` runs the ordinary decode
+        program — same math, no extra compile."""
+        if self.kv_layout != "paged":
+            raise ValueError("burst decode requires the paged KV layout")
+        if block_tables is None:
+            raise ValueError("paged decode requires block_tables")
+        n = int(n)
+        if n == 1:
+            return self.decode_step(tokens, active, temperature, top_p,
+                                    seeds, steps,
+                                    block_tables=block_tables)[:, None]
+        prog = self._burst_program(n)
+        self.cache, toks = prog(
+            self.params, self.cache, np.asarray(block_tables, np.int32),
             np.asarray(tokens, np.int32), np.asarray(active, bool),
             np.asarray(temperature, np.float32),
             np.asarray(top_p, np.float32),

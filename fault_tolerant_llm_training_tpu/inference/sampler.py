@@ -80,6 +80,25 @@ def slot_key(seed: jnp.ndarray, step: jnp.ndarray) -> jax.Array:
     return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 
 
+def sample_slot_tokens(logits: jnp.ndarray, seeds: jnp.ndarray,
+                       steps: jnp.ndarray, temperature: jnp.ndarray,
+                       top_p: jnp.ndarray, top_k: int = 0) -> jnp.ndarray:
+    """Whole-batch sampling epilogue: (slots, V) fp32 logits -> (slots,)
+    int32 tokens, each slot under its own ``slot_key(seed, step)`` stream.
+
+    This is THE sampling epilogue, fused and unfused alike: the decode
+    programs (engine.py ``_paged_decode_fn``/``_decode_fn``, the burst
+    loop's micro-steps) trace it in-program so the dispatch ends in token
+    ids, and the unfused path (``decode_logits`` + host-side sampling,
+    the bench's baseline) calls the very same function on the synced
+    logits. One definition, one PRNG schedule — which is why a fused
+    single step's streams bit-match the host-sampled ones.
+    """
+    keys = jax.vmap(slot_key)(seeds, steps)
+    return jax.vmap(sample_token, in_axes=(0, 0, 0, 0, None))(
+        logits, keys, temperature, top_p, top_k)
+
+
 def draft_key(seed: jnp.ndarray, step: jnp.ndarray) -> jax.Array:
     """Draft-proposal PRNG stream, disjoint from :func:`slot_key`'s so the
     draft model's sampling never aliases the target's (``step`` here is the

@@ -212,7 +212,7 @@ class Scheduler:
                  clock: Callable[[], float] = time.monotonic,
                  registry: Optional[MetricRegistry] = None,
                  stop_check: Optional[Callable[[], bool]] = None,
-                 adaptive_k=None):
+                 adaptive_k=None, decode_burst: int = 1):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -238,6 +238,34 @@ class Scheduler:
         # Speculative mode: the draft model's pool gets its own allocator
         # and block table; admission requires BOTH footprints (below).
         self.spec_k = int(getattr(engine, "spec_k", 0) or 0)
+        # Multi-token fused decode (engine.decode_burst): each step() runs
+        # ONE n-token burst program — 1 dispatch + 1 host sync for n
+        # tokens. Admission, EOS eviction, and the serve loop's
+        # stop/drain probes all happen BETWEEN bursts (a burst is inside
+        # one step() call, and the drain contract only ever promised
+        # iteration-boundary checks), so the signal-drain audit sequence
+        # is unchanged — a drain just lands at the next burst boundary,
+        # at most n-1 tokens later than per-token decode would.
+        self.decode_burst = int(decode_burst)
+        if self.decode_burst < 1:
+            raise ValueError(f"decode_burst {decode_burst} must be >= 1")
+        if self.decode_burst > 1:
+            if self.kv_layout != "paged":
+                raise ValueError("decode_burst > 1 requires the paged KV "
+                                 "layout")
+            if self.spec_k:
+                raise ValueError(
+                    "decode_burst > 1 and speculative decoding are "
+                    "mutually exclusive: a spec round already amortizes "
+                    "dispatches over k+1 tokens")
+            if not hasattr(engine, "decode_burst"):
+                raise ValueError("engine does not implement decode_burst")
+        # Dispatch/sync accounting (the fused-decode win in receipts):
+        # how many device programs were launched and how many host syncs
+        # were paid for the decode tokens generated.
+        self.decode_dispatches = 0
+        self.decode_host_syncs = 0
+        self.decode_tokens = 0
         # Optional sampler.AdaptiveK controller: when present, every spec
         # round runs at its chosen width (min per-request target) instead
         # of the engine's fixed spec_k — serve.py --adaptive-spec-k.
@@ -297,6 +325,19 @@ class Scheduler:
             "ftl_spec_tokens_per_round",
             "Tokens banked per verify round (accepted prefix + bonus, "
             "after EOS/budget truncation)",
+            buckets=SPEC_TOKEN_BUCKETS)
+        self._m_dispatches = r.counter(
+            "decode_dispatches_total",
+            "Device programs launched for decode (burst counts 1 per "
+            "burst; a spec round counts its draft + verify pair)")
+        self._m_host_syncs = r.counter(
+            "decode_host_syncs_total",
+            "Host round-trips paid for decode results (one per "
+            "device->host token/logit transfer)")
+        self._m_burst_tokens = r.histogram(
+            "decode_burst_tokens",
+            "Tokens banked per active slot per decode dispatch (after "
+            "EOS/budget truncation; 1 for per-token decode)",
             buckets=SPEC_TOKEN_BUCKETS)
         self._m_prefix_hit_rate = r.gauge(
             "kv_prefix_hit_rate",
@@ -571,6 +612,7 @@ class Scheduler:
             seeds[s] = st.request.seed
             steps[s] = st.steps
         t0 = self.clock()
+        burst_out = None
         if self.spec_k:
             # Speculative round: lengths[s] is the slot's committed KV
             # count (prompt + emitted − 1 positions hold keys; the latest
@@ -593,13 +635,41 @@ class Scheduler:
                 tokens, lengths, active, temperature, top_p, seeds, steps,
                 block_tables=self.block_tables,
                 draft_block_tables=self.draft_block_tables, **spec_kw)
+            self.decode_dispatches += 2  # draft-k + verify programs
+            self.decode_host_syncs += 1  # one (out, acc) sync per round
+            self._m_dispatches.inc(2)
+            self._m_host_syncs.inc()
+        elif self.kv_layout == "paged" and self.decode_burst > 1:
+            # One n-token burst program: clamp n to the tightest remaining
+            # budget so KV writes never walk past a slot's allocated
+            # blocks (admission sized them for prompt + max_new_tokens);
+            # EOS overshoot inside the burst is truncated at banking.
+            n = self.decode_burst
+            for st in self.active.values():
+                n = min(n, st.request.max_new_tokens - len(st.tokens))
+            n = max(int(n), 1)
+            burst_out = self.engine.decode_burst(
+                tokens, active, temperature, top_p, seeds, steps, n,
+                block_tables=self.block_tables)
+            self.decode_dispatches += 1
+            self.decode_host_syncs += 1
+            self._m_dispatches.inc()
+            self._m_host_syncs.inc()
         elif self.kv_layout == "paged":
             next_tokens = self.engine.decode_step(
                 tokens, active, temperature, top_p, seeds, steps,
                 block_tables=self.block_tables)
+            self.decode_dispatches += 1
+            self.decode_host_syncs += 1
+            self._m_dispatches.inc()
+            self._m_host_syncs.inc()
         else:
             next_tokens = self.engine.decode_step(tokens, active, temperature,
                                                   top_p, seeds, steps)
+            self.decode_dispatches += 1
+            self.decode_host_syncs += 1
+            self._m_dispatches.inc()
+            self._m_host_syncs.inc()
         step_wall = self.clock() - t0
         self.step_seconds.append(step_wall)
         self._m_decode.observe(step_wall)
@@ -610,17 +680,53 @@ class Scheduler:
         if self.spec_k:
             self._bank_spec(out, acc, done, k=round_k)
             return done
+        if burst_out is not None:
+            self._bank_burst(burst_out, done)
+            return done
         for s in list(self.active):
             st = self.active[s]
             tok = int(next_tokens[s])
             st.tokens.append(tok)
             st.steps += 1
+            self.decode_tokens += 1
             self._m_tokens.inc()
+            self._m_burst_tokens.observe(1)
             if self.eos_token_id is not None and tok == self.eos_token_id:
                 self._finish(s, "eos", done)
             elif len(st.tokens) >= st.request.max_new_tokens:
                 self._finish(s, "length", done)
         return done
+
+    def _bank_burst(self, out: np.ndarray, done: List[Completion]) -> None:
+        """Bank one fused burst's (slots, n) tokens, truncating each slot
+        at EOS and at its max_new_tokens budget — discarded overshoot is
+        tokens the sequential path would never have produced, so the
+        emitted stream stays identical to per-token decode (the same
+        truncation contract as ``_bank_spec``; the device's overshoot KV
+        is stale pool content past the committed length, masked and
+        overwritten by the slot's next occupant)."""
+        n = out.shape[1]
+        for s in list(self.active):
+            st = self.active[s]
+            banked = 0
+            finished = None
+            for i in range(n):
+                tok = int(out[s, i])
+                st.tokens.append(tok)
+                st.steps += 1
+                banked += 1
+                self._m_tokens.inc()
+                if (self.eos_token_id is not None
+                        and tok == self.eos_token_id):
+                    finished = "eos"
+                    break
+                if len(st.tokens) >= st.request.max_new_tokens:
+                    finished = "length"
+                    break
+            self.decode_tokens += banked
+            self._m_burst_tokens.observe(banked)
+            if finished:
+                self._finish(s, finished, done)
 
     def _bank_spec(self, out: np.ndarray, acc: np.ndarray,
                    done: List[Completion], k: Optional[int] = None) -> None:
@@ -663,7 +769,9 @@ class Scheduler:
                 if len(st.tokens) >= st.request.max_new_tokens:
                     finished = "length"
                     break
+            self.decode_tokens += banked
             self._m_spec_round_tokens.observe(banked)
+            self._m_burst_tokens.observe(banked)
             if finished:
                 self._finish(s, finished, done)
         self.spec_accepted_tokens += round_accepted
@@ -739,6 +847,16 @@ class Scheduler:
             "tokens_per_sec_per_slot": tps / max(self.engine.slots, 1),
             "prefill_chunks": self.prefill_chunks,
             "prefill_seconds": self.prefill_seconds,
+            "decode_burst": self.decode_burst,
+            "decode_dispatches": self.decode_dispatches,
+            "decode_host_syncs": self.decode_host_syncs,
+            "decode_tokens": self.decode_tokens,
+            "dispatches_per_token": (
+                self.decode_dispatches / self.decode_tokens
+                if self.decode_tokens else 0.0),
+            "host_syncs_per_token": (
+                self.decode_host_syncs / self.decode_tokens
+                if self.decode_tokens else 0.0),
         }
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity

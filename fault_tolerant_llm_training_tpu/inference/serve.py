@@ -162,6 +162,23 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "full reservation parity (slots * max_len worth). "
                         "Set LOWER to serve more slots at the same HBM, "
                         "admission queues on block exhaustion")
+    p.add_argument("--paged-kernel", default="gather",
+                   choices=("gather", "pallas"),
+                   help="paged attention kernel (paged layout): 'gather' "
+                        "assembles each slot's blocks into a contiguous "
+                        "view and runs the ring kernel on it — the "
+                        "bit-exact reference; 'pallas' reads pool blocks "
+                        "in place through the block table "
+                        "(ops/paged_attention.py) — no gathered copy, "
+                        "equal within fp32 accumulation tolerance")
+    p.add_argument("--decode-burst", type=int, default=1,
+                   help="tokens per decode dispatch (paged layout): n > 1 "
+                        "runs an n-token fused burst program — one "
+                        "dispatch + one host sync per n tokens, greedy "
+                        "streams bit-identical to per-token decode. "
+                        "Admission/EOS eviction and the drain/stop probes "
+                        "land at burst boundaries (at most n-1 tokens "
+                        "later); mutually exclusive with --spec-k")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the content-addressed prefix cache "
                         "(paged layout): admissions sharing a committed "
@@ -316,7 +333,8 @@ def main(argv=None) -> None:
             top_k=args.top_k, kv_layout=args.kv_layout,
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks or None,
-            prefix_cache=not args.no_prefix_cache, **spec_kwargs)
+            prefix_cache=not args.no_prefix_cache,
+            paged_kernel=args.paged_kernel, **spec_kwargs)
         if args.spec_k:
             engine.draft_restored_step = draft_step_restored
             logger.info(
@@ -339,7 +357,8 @@ def main(argv=None) -> None:
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id),
                           stop_check=lambda: flag.signum is not None,
-                          adaptive_k=adaptive)
+                          adaptive_k=adaptive,
+                          decode_burst=args.decode_burst)
         prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
                    ) * args.repeat
         for i, text in enumerate(prompts):
@@ -446,6 +465,14 @@ def main(argv=None) -> None:
                 m["requests_completed"], m["tokens_generated"],
                 m["tokens_per_sec"], m["tokens_per_sec_per_slot"],
                 m["decode_p50_ms"], m["decode_p95_ms"])
+    # the fused-decode win in the drain receipt: per-token decode reads
+    # 1.00 dispatches/token; burst n amortizes toward 1/n
+    logger.info("Decode dispatch metrics: burst=%d | %d dispatches | "
+                "%d host syncs | %d decode tokens | "
+                "%.3f dispatches/token | %.3f syncs/token",
+                m["decode_burst"], m["decode_dispatches"],
+                m["decode_host_syncs"], m["decode_tokens"],
+                m["dispatches_per_token"], m["host_syncs_per_token"])
     if args.spec_k:
         logger.info(
             "Spec metrics: k=%d | %d rounds | %d drafted | %d accepted | "

@@ -31,6 +31,13 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16  # activations / compute
     param_dtype: jnp.dtype = jnp.bfloat16  # weights (and hence AdamW moments)
     attention_impl: str = "auto"
+    # Paged-KV attention kernel (serving decode path only; read where the
+    # cache is a block pool): "gather" assembles each slot's blocks into a
+    # contiguous view and runs the ring kernel on it (bit-exact reference),
+    # "pallas" reads pool blocks in place through the block table
+    # (ops/paged_attention.py — no gathered copy; equal to gather within
+    # fp32 accumulation tolerance). Training never reads this field.
+    paged_kernel: str = "gather"
     # Sequence layout under sequence parallelism: "zigzag" (each shard holds
     # one early + one mirrored late chunk — balances causal work around the
     # ring at ~2x fewer FLOPs; ops/ring_attention.py) or "contiguous".
@@ -152,6 +159,7 @@ class TransformerConfig:
                                ("rope_impl", ("xla", "fused")),
                                ("attention_impl",
                                 ("auto", "xla", "pallas", "ring")),
+                               ("paged_kernel", ("gather", "pallas")),
                                ("embed_impl", ("auto", "gather", "one_hot")),
                                ("moe_impl",
                                 ("auto", "capacity", "sorted"))):

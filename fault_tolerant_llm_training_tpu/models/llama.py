@@ -237,9 +237,9 @@ class Attention(nn.Module):
                 v_pool = write_paged_kv(
                     v_pool, jnp.transpose(v, (0, 2, 1, 3)), block_tables,
                     offsets, write_valid)
-                from ..ops.attention import paged_cached_attention
-                out = paged_cached_attention(q, k_pool, v_pool,
-                                             block_tables, offsets)
+                from ..ops.attention import paged_attention
+                out = paged_attention(q, k_pool, v_pool, block_tables,
+                                      offsets, impl=cfg.paged_kernel)
                 out = out.reshape(b, s, cfg.n_heads * dh)
                 return (nn.Dense(cfg.dim, name="wo", **dense)(out),
                         (k_pool, v_pool))
@@ -529,7 +529,7 @@ class Transformer(nn.Module):
         positions ``offsets[b] + [0, k]``; each row's logits are the
         target's next-token scores AFTER that prefix — the same masked
         attention the j-th sequential single-token decode computes
-        (ops/attention.py ``paged_verify_attention`` documents the masking
+        (ops/attention.py ``paged_attention`` documents the masking
         argument), though only equal to it up to shape-dependent bf16 GEMM
         accumulation order: a one-ulp logit near-tie can flip an argmax
         between the chunked and single-step programs, which is why the

@@ -133,9 +133,11 @@ def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     exactly and contribute exact zeros to the fp32 accumulation, just like
     the ring buffer's masked tail. This is the portable XLA-level reference
     of vLLM's PagedAttention: the gather materializes a transient per-call
-    contiguous view instead of a fused block-indexed kernel, which is the
-    right first rung on CPU/XLA and the semantics a later Pallas kernel
-    must reproduce.
+    contiguous view instead of a fused block-indexed kernel — the
+    semantics the in-place Pallas kernel (ops/paged_attention.py)
+    reproduces to fp32 accumulation tolerance, and the bit-exact
+    reference it is tested against (:func:`paged_attention` dispatches
+    between the two).
 
     Prefix sharing needs NO change here: a block referenced by several
     slots' table rows (prefix-cache hit) is gathered into each of their
@@ -148,38 +150,61 @@ def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                             gather_kv_blocks(v_pool, block_tables), offsets)
 
 
-def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
-                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
-                           offsets: jnp.ndarray) -> jnp.ndarray:
-    """Length-k masked verify attention for speculative decoding.
+def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                    v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                    offsets: jnp.ndarray, impl: str = "gather"
+                    ) -> jnp.ndarray:
+    """Paged-attention kernel dispatch — THE routing point for every read
+    through the block tables (decode, chunked prefill, spec-verify; the
+    former ``paged_verify_attention`` alias collapsed into this).
 
-    The verify pass scores S = k+1 candidate positions per slot in ONE
-    forward: queries sit at ``offsets[b] + [0, k]`` (row 0 re-scores the
-    committed last token's position, rows 1..k the draft proposals), and the
-    per-row causal mask of :func:`cached_attention` — ``k_pos <= offsets[b]
-    + j`` — is exactly the verify mask: row j attends the committed prefix
-    plus proposals 1..j and nothing later. The draft rows' keys/values are
-    written through the block tables BEFORE this runs (models/llama.py), so
-    row j attends the same positions j sequential single-token decode steps
-    would have — the verify logits ARE the non-speculative logits up to
-    shape-dependent GEMM accumulation order (the surrounding projections,
-    not this mask, are where a one-ulp bf16 near-tie can diverge; the
-    engine's verify program micro-steps S=1 forwards when it needs bitwise
-    greedy equality — see inference/engine.py ``_verify_fn``).
-    Rejected-suffix positions need no device-side rollback: their stale
-    pool entries sit past the slot's committed length, the mask zeroes them
-    (``exp(finfo.min) == 0`` exactly), and the next round overwrites them.
-
-    Shapes/semantics otherwise match :func:`paged_cached_attention`, whose
-    gather path it reuses unchanged.
+    - ``"gather"`` — :func:`paged_cached_attention`: gather-then-ring,
+      the portable bit-exact reference (serving's ``--paged-kernel
+      gather``).
+    - ``"pallas"`` — the in-place block-indexed kernel
+      (ops/paged_attention.py): pool blocks are DMA'd straight through
+      the table, no gathered copy. Equal to gather within fp32
+      accumulation tolerance (online softmax reorders the reduction).
+      DECODE shapes only: for S > 1 this falls back to gather, because
+      the multi-token shapes don't pay the gather tax where it hurts —
+      chunked prefill runs once per prompt (and its S=bucket rows would
+      need the kernel to walk a q-position axis too), and the engine's
+      default ``"exact"`` spec-verify never issues an S=k+1 read at all
+      (it micro-steps S=1 forwards for bitwise greedy equality, so
+      spec-decode's verify DOES get the in-place read, one micro-step at
+      a time). The S>1 chunk-verify mode keeps the gather path's
+      documented masking: query row j at ``offsets[b] + j`` attends
+      ``k_pos <= offsets[b] + j`` — the committed prefix plus proposals
+      1..j — and rejected-suffix/stale positions are zeroed by the same
+      ``exp(finfo.min) == 0`` mask, no device-side rollback.
     """
-    return paged_cached_attention(q, k_pool, v_pool, block_tables, offsets)
+    if impl == "gather":
+        return paged_cached_attention(q, k_pool, v_pool, block_tables,
+                                      offsets)
+    if impl == "pallas":
+        if q.shape[1] == 1:
+            from .paged_attention import paged_decode_attention
+            return paged_decode_attention(q, k_pool, v_pool, block_tables,
+                                          offsets)
+        return paged_cached_attention(q, k_pool, v_pool, block_tables,
+                                      offsets)
+    raise ValueError(f"unknown paged attention impl: {impl!r} "
+                     f"(want 'gather' or 'pallas')")
 
 
 def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         impl: str = "auto", causal: bool = True) -> jnp.ndarray:
-    """Dispatch to the requested attention implementation."""
-    if impl == "auto":
+    """Dispatch to the requested attention implementation.
+
+    ``"ring"`` is accepted (models/configs.py admits it as an
+    ``attention_impl``) but resolves like ``"auto"``: ring attention is
+    the sequence-parallel collective form (ops/ring_attention.py) and
+    only exists under a mesh with a >1 'sequence' axis — the model layer
+    routes it there itself (models/llama.py). A direct single-device
+    call has no axis to ring over, so it gets the equivalent dense
+    kernel instead of an opaque raise.
+    """
+    if impl in ("auto", "ring"):
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal)

@@ -1,0 +1,170 @@
+"""Pallas paged-attention decode kernel: block-indexed KV reads in place.
+
+The XLA-level paged path (ops/attention.py ``paged_cached_attention``)
+gathers each slot's pool blocks into a transient contiguous (B, K, T, D)
+copy and runs the ring kernel's einsum on it — correct by construction,
+but the gather is an extra full-cache pass per layer per decode step.
+This module is the decode-side member of the repo's Pallas kernel family
+(flash_attention.py prefill, ring_flash.py sequence-parallel): the block
+table rides in as a scalar-prefetch operand, the grid's innermost axis
+walks a slot's logical blocks, and each step's BlockSpec index map sends
+the DMA straight at ``pool[tables[b, j]]`` — the pool is read THROUGH
+the table with no gathered intermediate, vLLM's PagedAttention fused
+with flash-decoding's split-KV online softmax.
+
+Masking reproduces the gather path's semantics exactly and entirely by
+position: a decode query at position ``offsets[b]`` attends keys at
+``k_pos <= offsets[b]``. Everything the gather path neutralizes with its
+additive ``finfo.min`` mask — null-block-0 garbage behind unallocated
+table entries, stale KV in freed-and-reused blocks, the written-ahead
+tail of a COW'd final block — sits past that boundary, so the same
+comparison excludes it here: masked lanes get ``exp2(NEG_INF - m) == 0``
+probability exactly, and blocks that start past the boundary are skipped
+wholesale (``@pl.when``), never touching the accumulator. Shared prefix
+blocks need no handling at all: a block referenced by several rows is
+simply DMA'd for each, same bytes.
+
+Numerics follow the house flash-decoding scheme (flash_attention.py):
+base-2 online softmax with ``log2(e)`` folded into the q prescale, fp32
+(m, l, acc) carried in VMEM scratch across the block axis, one rescale +
+normalize at the last block. Accumulation order therefore differs from
+the gather path's full-row softmax — equality holds to fp32 accumulation
+tolerance, not bitwise, which is why the engine keeps the gather program
+selectable as the bit-exact reference (``--paged-kernel gather``).
+
+Decode-specialized: S = 1 per slot (the query row is the slot's GQA
+group, (G, D)). Multi-token shapes — chunked prefill, chunk-mode
+spec-verify — stay on the gather path (ops/attention.py
+``paged_attention`` routes; its docstring carries the argument).
+Runs under ``interpret=True`` off-TPU like every kernel here, so tier-1
+asserts the equivalence on CPU (tests/test_paged_kernel.py).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import LOG2E, NEG_INF, _interpret
+
+# m/l scratch rides full lanes: TPU VMEM tiles pad the trailing dim to
+# 128 anyway, and a (G, 128) broadcast store beats a strided (G, 1) one.
+_STAT_LANES = 128
+
+
+def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_size: int, scale: float):
+    """One (slot b, kv-head h, logical block j) grid step.
+
+    k_ref/v_ref are the (1, 1, bs, D) pool slices the index map already
+    aimed at ``tables[b, j]`` — the kernel never sees a block id, only
+    the block's bytes. Carry (m, l, acc) lives in VMEM scratch revisited
+    across the innermost j axis; j == 0 initializes, the last j emits.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    offset = offs_ref[b]  # this slot's decode position (committed length)
+
+    # Blocks whose first position is already past the query position are
+    # fully masked — skip them (freed/stale/null-table tail). The carry
+    # is untouched, exactly as an all -inf block contributes nothing.
+    @pl.when(j * block_size <= offset)
+    def _block():
+        q2 = (q_ref[0, 0].astype(jnp.float32)
+              * (scale * LOG2E)).astype(q_ref.dtype)       # (G, D)
+        s = jax.lax.dot_general(                           # (G, bs) fp32
+            q2, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        g = s.shape[0]
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block_size), 1)
+        s = jnp.where(k_pos <= offset, s, NEG_INF)
+        m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = (acc_scr[...] * alpha[:, None]
+                        + jax.lax.dot_general(
+                            p.astype(v_ref.dtype), v_ref[0, 0],
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        # l >= exp2(0) always: position ``offset`` itself is in range
+        # (the decode writes the query token's KV before attending).
+        o_ref[0, 0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                           offsets: jnp.ndarray,
+                           interpret: bool = None) -> jnp.ndarray:
+    """S=1 GQA paged attention reading pool blocks in place via the table.
+
+    q:            (B, 1, H, D) decode queries (rope applied, KV written).
+    k/v_pool:     (N, K, bs, D) global block pools (kv_cache.py layout).
+    block_tables: (B, NB) int32 — slot b's logical block j is pool block
+                  ``block_tables[b, j]``; 0 (the null block) for
+                  unallocated entries.
+    offsets:      (B,) int32 query positions; keys at ``k_pos <=
+                  offsets[b]`` attend, everything else is masked (see
+                  module docstring for why that alone covers every
+                  adversarial pool state).
+
+    Returns (B, 1, H, D), equal to ``paged_cached_attention`` on the same
+    operands to fp32 accumulation tolerance.
+    """
+    b, s_q, h, d = q.shape
+    if s_q != 1:
+        raise ValueError(f"paged_decode_attention is S=1-specialized, got "
+                         f"S={s_q} (multi-token shapes take the gather "
+                         f"path — ops/attention.py paged_attention)")
+    n, kv, bs, _ = k_pool.shape
+    g = h // kv
+    nb = block_tables.shape[1]
+    qg = q.reshape(b, kv, g, d)  # head-major: (B, K, G, D)
+    tables = block_tables.reshape(-1).astype(jnp.int32)
+    offs = offsets.astype(jnp.int32)
+    kernel = functools.partial(_decode_kernel, block_size=bs,
+                               scale=1.0 / math.sqrt(d))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, bs, d),
+                             lambda bi, hi, j, t, o: (t[bi * nb + j],
+                                                      hi, 0, 0)),
+                pl.BlockSpec((1, 1, bs, d),
+                             lambda bi, hi, j, t, o: (t[bi * nb + j],
+                                                      hi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, d),
+                                   lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g, _STAT_LANES), jnp.float32),  # m
+                pltpu.VMEM((g, _STAT_LANES), jnp.float32),  # l
+                pltpu.VMEM((g, d), jnp.float32),            # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, g, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(tables, offs, qg, k_pool, v_pool)
+    return out.reshape(b, 1, h, d)

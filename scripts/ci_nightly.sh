@@ -17,7 +17,13 @@
 #    and holds it to the committed BENCH_decode_prefix_cpu.json
 #    acceptance bars: cached N=8 prefill <= 2x N=1 and
 #    kv_prefix_hit_rate > 0.8 (the hit rate is deterministic and must
-#    equal the receipt exactly; timings are machine-dependent).
+#    equal the receipt exactly; timings are machine-dependent);
+# 4. fused_decode bench — re-runs the burst-decode scenario and pins
+#    the dispatch contract from BENCH_decode_fused_cpu.json: every
+#    burst-n point spends <= 1/n + eps dispatches AND host syncs per
+#    token, and the fused sampling epilogue's greedy streams are
+#    bit-identical to the unfused host-sampled baseline (throughput
+#    numbers are machine-dependent and not pinned).
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -93,4 +99,31 @@ print(f"ok: cached N8/N1 prefill {ratio:.2f}x (<= 2x), "
       f"hit rate {rate:.3f} (> 0.8, matches receipt)")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, prefix bench)"
+echo "== fused_decode bench vs committed receipt"
+python scripts/decode_bench.py --scenario fused_decode --requests 8 \
+    --out "$WORK/bench_fused.json"
+python - "$WORK/bench_fused.json" BENCH_decode_fused_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+EPS = 0.05
+for p in got["points"]:
+    n = p["burst"]
+    for key in ("dispatches_per_token", "host_syncs_per_token"):
+        assert p[key] <= 1.0 / n + EPS, (
+            f"{p['kernel']} burst={n}: {key} {p[key]} > 1/{n} + {EPS}")
+    assert p["bit_match_burst1"], (
+        f"{p['kernel']} burst={n} stream diverged from per-token decode")
+assert got["fused_bit_match_host_sampler"], (
+    "fused epilogue greedy streams diverged from host-sampled baseline")
+assert want["fused_bit_match_host_sampler"], "committed receipt is stale"
+worst = max(p["dispatches_per_token"] for p in got["points"]
+            if p["burst"] == max(got["burst_ns"]))
+print(f"ok: burst {got['burst_ns']} dispatches/token bounded by 1/n + "
+      f"{EPS} (worst at n={max(got['burst_ns'])}: {worst}), fused == "
+      f"host-sampled bitwise")
+EOF
+
+echo "OK: nightly green (slow suite, chaos survival, prefix bench, fused decode)"

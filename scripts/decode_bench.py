@@ -57,7 +57,10 @@ def main():
     p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
     p.add_argument("--scenario", default="uniform",
                    choices=("uniform", "long_context", "spec_decode",
-                            "shared_prefix"))
+                            "shared_prefix", "fused_decode"))
+    p.add_argument("--burst-ns", default="1,4,8",
+                   help="fused_decode scenario: comma-separated burst "
+                        "lengths (tokens per dispatch) to sweep")
     p.add_argument("--spec-ks", default="2,4,8,12",
                    help="spec_decode scenario: comma-separated draft "
                         "depths to sweep")
@@ -136,6 +139,8 @@ def main():
         result = _spec_decode(args, reqs, vocab)
     elif args.scenario == "shared_prefix":
         result = _shared_prefix(args, vocab)
+    elif args.scenario == "fused_decode":
+        result = _fused_decode(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -143,7 +148,8 @@ def main():
     print(json.dumps(result))
     default_name = {"long_context": "BENCH_decode_paged",
                     "spec_decode": "BENCH_decode_spec",
-                    "shared_prefix": "BENCH_decode_prefix"}.get(
+                    "shared_prefix": "BENCH_decode_prefix",
+                    "fused_decode": "BENCH_decode_fused"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -572,6 +578,161 @@ def _shared_prefix(args, vocab):
         "shared_prefix_tokens": shared_len,
         "unique_suffix_tokens": suffix_len,
         "kv_block_size": bs,
+        "points": points,
+    }
+
+
+def _fused_decode(args, vocab):
+    """Fused decode: kernel (gather vs pallas) x burst n, plus the fused
+    sampling epilogue against its unfused host-sampled baseline.
+
+    All requests are GREEDY so every stream comparison is exact:
+
+    - kernel x burst grid: each point drives the full scheduler with
+      ``decode_burst=n``; its streams are asserted bit-identical to the
+      same kernel's burst-1 streams (``_bank_burst`` truncation included
+      — gen is deliberately not a burst multiple), and the scheduler's
+      own dispatch accounting gives dispatches/token and host-syncs/token
+      (2 active-slot batching means the bar is 1/(n * slots), but the
+      receipt pins only the burst bound <= 1/n + eps).
+    - fused vs unfused: same engine, T decode iterations either through
+      the fused program (token ids sync, 4 bytes/slot) or through
+      ``decode_logits`` + host ``sample_slot_tokens`` (a (slots, vocab)
+      fp32 plane per step). Streams are ASSERTED bit-identical — both
+      regimes trace the SAME sampler.py epilogue — and the timing ratio
+      is the sync-elimination win (modest on CPU where the "sync" is a
+      copy; the dispatch/token column is the accelerator-relevant bound).
+
+    Headline value: dispatches/token at the largest burst — the ISSUE's
+    "n tokens for ONE dispatch + ONE host sync" contract, measured.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.sampler import (
+        sample_slot_tokens)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    cfg = get_config(args.model, vocab_size=vocab,
+                     layer_impl=args.layer_impl)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    slots, prompt_len, gen, bs = 4, 32, 45, 16
+    max_len = prompt_len + gen + bs
+    ns = [int(n) for n in args.burst_ns.split(",")]
+    lrng = np.random.default_rng(args.seed + 31)
+    prompts = [lrng.integers(3, vocab, size=prompt_len).tolist()
+               for _ in range(args.requests)]
+
+    def run(engine, n):
+        engine.reset()
+        sched = Scheduler(engine, eos_token_id=None, decode_burst=n)
+        for i, pr in enumerate(prompts):
+            sched.submit(Request(id=f"r{i}", prompt=pr,
+                                 max_new_tokens=gen))
+        t0 = time.monotonic()
+        out = sched.run()
+        m = sched.metrics()
+        m["wall_seconds"] = time.monotonic() - t0
+        return m, {c.request_id: c.tokens for c in out}
+
+    points = []
+    baseline_tps = None
+    for kernel in ("gather", "pallas"):
+        engine = InferenceEngine(cfg, params, slots=slots, max_len=max_len,
+                                 prefill_buckets=(16, 32), kv_layout="paged",
+                                 kv_block_size=bs, paged_kernel=kernel)
+        run(engine, max(ns))                       # warm every program
+        _, seq_streams = run(engine, 1)
+        if kernel == "gather":
+            gather_streams, gather_engine = seq_streams, engine
+            mismatched = 0
+        else:
+            # RECORDED, not asserted: the in-place kernel's online softmax
+            # reorders the fp32 reduction, so a bf16 logit near-tie can
+            # legitimately flip a greedy argmax (same caveat the spec
+            # chunk-verify points document). The bit-pinned comparisons
+            # are within-kernel: burst-vs-sequential and fused-vs-host.
+            mismatched = sum(seq_streams[r] != gather_streams[r]
+                             for r in gather_streams)
+        for n in ns:
+            m, streams = run(engine, n)
+            assert streams == seq_streams, (
+                f"burst={n} kernel={kernel} diverged from per-token decode")
+            if kernel == "gather" and n == 1:
+                baseline_tps = m["tokens_per_sec"]
+            points.append({
+                "kernel": kernel,
+                "burst": n,
+                "tokens_per_sec": round(m["tokens_per_sec"], 1),
+                "speedup_vs_gather_burst1": (
+                    None if baseline_tps is None
+                    else round(m["tokens_per_sec"] / baseline_tps, 2)),
+                "dispatches_per_token": round(m["dispatches_per_token"], 4),
+                "host_syncs_per_token": round(m["host_syncs_per_token"], 4),
+                "decode_p50_ms": round(m["decode_p50_ms"], 3),
+                "bit_match_burst1": True,          # asserted above
+                "greedy_streams_mismatched_vs_gather": mismatched,
+            })
+        engine = None if kernel == "pallas" else engine
+
+    # fused epilogue vs unfused host-sampled baseline, engine level
+    eng = gather_engine
+    nb = -(-max_len // bs)                         # blocks per slot, ceil
+    rows = np.arange(1, slots * nb + 1, dtype=np.int32).reshape(slots, nb)
+    temperature = np.zeros(slots, np.float32)
+    top_p = np.ones(slots, np.float32)
+    seeds = np.zeros(slots, np.int32)
+    active = np.ones(slots, bool)
+
+    def decode_loop(fused):
+        eng.reset()
+        toks = np.array([eng.prefill(s, prompts[s], block_row=rows[s])
+                         for s in range(slots)], np.int32)
+        stream = [toks.copy()]
+        t0 = time.monotonic()
+        for step in range(1, gen):
+            steps = np.full(slots, step, np.int32)
+            if fused:
+                toks = eng.decode_step(toks, active, temperature, top_p,
+                                       seeds, steps, block_tables=rows)
+            else:
+                logits = eng.decode_logits(toks, active, block_tables=rows)
+                toks = np.asarray(sample_slot_tokens(
+                    logits, seeds, steps, temperature, top_p, eng.top_k))
+            stream.append(np.asarray(toks).copy())
+        return time.monotonic() - t0, np.stack(stream)
+
+    decode_loop(True)                              # warm both programs
+    decode_loop(False)
+    fused_s, fused_stream = decode_loop(True)
+    unfused_s, unfused_stream = decode_loop(False)
+    fused_bit_match = bool((fused_stream == unfused_stream).all())
+    assert fused_bit_match, "fused epilogue diverged from host sampler"
+
+    best = min(points, key=lambda p: p["dispatches_per_token"])
+    return {
+        "metric": (f"decode dispatches/token at burst {max(ns)} "
+                   f"({args.model}, {slots} slots, prompt {prompt_len}, "
+                   f"gen {gen}, backend {jax.default_backend()})"),
+        "value": best["dispatches_per_token"],
+        "unit": "dispatches/token (1/(burst*slots) ideal; 1.0 = per-token)",
+        "burst_ns": ns,
+        "slots": slots,
+        "gen_tokens": gen,
+        "fused_bit_match_host_sampler": fused_bit_match,
+        "fused_decode_seconds": round(fused_s, 4),
+        "unfused_decode_seconds": round(unfused_s, 4),
+        "fused_vs_unfused_speedup": round(unfused_s / fused_s, 2),
         "points": points,
     }
 

@@ -206,6 +206,55 @@ def check_ring_carry_64k(s=65536, sp=8, h=4, kv=2, d=64):
     return ok
 
 
+def check_paged_decode_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64,
+                              dtype=jnp.bfloat16):
+    """Pallas paged-decode kernel vs the gather reference, compiled on the
+    chip at serving shapes, over an adversarial pool: shuffled block order,
+    garbage null block, freed tails fallen back to block 0, stale table
+    entries aimed at orphaned blocks, two slots sharing prefix blocks, and
+    offsets pinned to block boundaries. The CPU tests pin the same matrix
+    in interpret mode (tests/test_paged_kernel.py); this pins the MOSAIC
+    lowering at the tuned head widths."""
+    from fault_tolerant_llm_training_tpu.ops.attention import (
+        paged_cached_attention,
+    )
+    from fault_tolerant_llm_training_tpu.ops.paged_attention import (
+        paged_decode_attention,
+    )
+
+    rng = np.random.default_rng(3)
+    n_pool = slots * nb + 4                 # null + spare orphan blocks
+    pool_k = jnp.asarray(rng.standard_normal((n_pool, kv, bs, d)), dtype)
+    pool_v = jnp.asarray(rng.standard_normal((n_pool, kv, bs, d)), dtype)
+    perm = rng.permutation(np.arange(1, slots * nb + 1))
+    tables = perm.reshape(slots, nb).astype(np.int32)
+    offsets = rng.integers(1, nb * bs - 1, size=slots).astype(np.int32)
+    offsets[0] = 2 * bs                     # decode lands ON a boundary
+    offsets[1] = bs - 1                     # last position of block 0
+    for b in range(slots):                  # free blocks past the live tail
+        tables[b, int(offsets[b]) // bs + 1:] = 0
+    tables[2, -1] = n_pool - 1              # stale entry at an orphan block
+    tables[3, :2] = tables[2, :2]           # shared prefix rows
+    q = jnp.asarray(rng.standard_normal((slots, 1, h, d)), dtype)
+    tables = jnp.asarray(tables)
+    offsets = jnp.asarray(offsets)
+
+    want = jax.jit(paged_cached_attention)(q, pool_k, pool_v, tables,
+                                           offsets)
+    got = jax.jit(paged_decode_attention)(q, pool_k, pool_v, tables,
+                                          offsets)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(want.astype(jnp.float32)))) or 1.0
+    ok = err / scale < 2e-2
+    print(json.dumps({
+        "check": (f"paged_decode_vs_gather_onchip slots={slots} kv={kv} "
+                  f"h={h} bs={bs} nb={nb} d={d}"),
+        "max_abs_err": err, "rel": err / scale, "ok": ok,
+    }), flush=True)
+    return ok
+
+
 def main():
     ok = True
     ok &= check_flash_parity(2048, 12, 12, 64)   # resident, bench shape
@@ -222,6 +271,8 @@ def main():
     ok &= check_rope_fused_parity(2048, 4, 2, 128)  # rope AT the boundary
     ok &= check_ring_carry_64k()
     ok &= check_ring_carry_64k(s=32768, sp=4, h=2, kv=2, d=128)
+    ok &= check_paged_decode_parity()                       # serving, D=64
+    ok &= check_paged_decode_parity(h=8, kv=4, d=128)       # flagship width
     sys.exit(0 if ok else 1)
 
 
