@@ -149,7 +149,8 @@ class InferenceEngine:
                  draft_num_blocks: Optional[int] = None,
                  spec_verify_impl: str = "exact",
                  prefix_cache: bool = True,
-                 paged_kernel: str = "gather"):
+                 paged_kernel: str = "gather",
+                 prefill_batch: int = 1):
         if kv_layout not in ("paged", "ring"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
         if paged_kernel not in ("gather", "pallas"):
@@ -182,6 +183,18 @@ class InferenceEngine:
             raise ValueError(f"prefill bucket {buckets[-1]} exceeds "
                              f"max_len {self.max_len}")
         self.prefill_buckets = buckets
+        # Packed multi-request prefill (prefill_batch > 1): a second AOT
+        # bucket ladder whose programs run P requests' next chunks in ONE
+        # (P, bucket) dispatch — the scheduler's packed admission lane.
+        self.prefill_batch = int(prefill_batch)
+        if not 1 <= self.prefill_batch <= slots:
+            raise ValueError(
+                f"prefill_batch {prefill_batch} outside [1, slots={slots}]: "
+                f"each packed row prefills into its own cache slot")
+        if self.prefill_batch > 1 and kv_layout != "paged":
+            raise ValueError("prefill_batch > 1 requires the paged KV "
+                             "layout (each packed row writes through its "
+                             "own block-table row)")
         if kv_layout == "paged":
             self.block_size = kv_block_size
             self.max_blocks_per_slot = blocks_per_slot(self.max_len,
@@ -213,6 +226,13 @@ class InferenceEngine:
                     f"models' distributions token-for-token")
             if not 1 <= self.spec_k < self.max_len:
                 raise ValueError(f"spec_k {spec_k} outside [1, max_len)")
+            if self.prefill_batch > 1:
+                raise ValueError(
+                    "prefill_batch > 1 and speculative decoding are "
+                    "mutually exclusive: spec-mode prefill streams the "
+                    "DRAFT pool sequentially after the target phase, and "
+                    "packing that second lifecycle is a separate program "
+                    "family")
             if spec_verify_impl not in ("exact", "chunk"):
                 raise ValueError(
                     f"unknown spec_verify_impl {spec_verify_impl!r}: "
@@ -342,6 +362,49 @@ class InferenceEngine:
         tok = sample_token(last, slot_key(seed, jnp.int32(0)),
                            temperature, top_p, self.top_k)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), tok
+
+    def _packed_prefill_fn(self, model, params, cache, block_rows, tokens,
+                           slots, chunk_start, chunk_len, active,
+                           temperature, top_p, seeds):
+        """P prefill CHUNKS in ONE dispatch: row i is request i's next
+        (1, bucket) chunk at its OWN absolute offset ``chunk_start[i]``
+        through its OWN block-table row — the batched sibling of
+        ``_paged_prefill_fn``. Inactive pad rows (fewer than P requests
+        share this round's bucket) run with all-False write_valid, so
+        their writes divert to the null block and their lengths are left
+        alone.
+
+        Bit-exactness vs sequential B=1 prefill: the batch dim is a
+        PARALLEL dim of every GEMM — each row's contraction shapes are
+        exactly the (1, bucket) program's, unlike the S=1 -> S=k+1
+        chunk-verify case where the contraction itself changes shape —
+        and the per-row epilogue below is a static unroll whose ops
+        (scalar length update, (V,) ``sample_token``) are the sequential
+        program's exact shapes. Packed streams are therefore bit-identical
+        to sequential prefill on the gather impl (asserted, not assumed:
+        tests/test_paged_kv.py, the bench receipt)."""
+        p_rows, bucket = tokens.shape
+        valid = ((jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                  < chunk_len[:, None]) & active[:, None])
+        logits, (nk, nv) = model.apply(
+            {"params": params}, tokens, cache.k, cache.v, chunk_start,
+            block_tables=block_rows, write_valid=valid,
+            method="forward_with_cache")
+        lengths = cache.lengths
+        toks = []
+        for i in range(p_rows):
+            lengths = jnp.where(
+                active[i],
+                jax.lax.dynamic_update_slice(
+                    lengths, (chunk_start[i] + chunk_len[i])[None],
+                    (slots[i],)),
+                lengths)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[i], jnp.maximum(chunk_len[i] - 1, 0), 1,
+                0)[0].astype(jnp.float32)
+            toks.append(sample_token(last, slot_key(seeds[i], jnp.int32(0)),
+                                     temperature[i], top_p[i], self.top_k))
+        return PagedKVCache(k=nk, v=nv, lengths=lengths), jnp.stack(toks)
 
     def _paged_decode_fn(self, params, cache, block_tables, tokens, active,
                          temperature, top_p, seeds, steps):
@@ -604,6 +667,22 @@ class InferenceEngine:
                     donate_argnums=(1,)).lower(
                     p_abs, c_abs, row_abs, tok_abs, scalar_i, scalar_i,
                     scalar_i, scalar_f, scalar_f, scalar_i).compile()
+            self._packed_prefill = {}
+            if self.prefill_batch > 1:
+                p = self.prefill_batch
+                rows_abs = jax.ShapeDtypeStruct(
+                    (p, self.max_blocks_per_slot), jnp.int32)
+                p_i = jax.ShapeDtypeStruct((p,), jnp.int32)
+                p_f = jax.ShapeDtypeStruct((p,), jnp.float32)
+                p_b = jax.ShapeDtypeStruct((p,), jnp.bool_)
+                for b in self.prefill_buckets:
+                    tok_abs = jax.ShapeDtypeStruct((p, b), jnp.int32)
+                    self._packed_prefill[b] = jax.jit(
+                        functools.partial(self._packed_prefill_fn,
+                                          self.model),
+                        donate_argnums=(1,)).lower(
+                        p_abs, c_abs, rows_abs, tok_abs, p_i, p_i, p_i,
+                        p_b, p_f, p_f, p_i).compile()
             if self.spec_k:
                 dp_abs = _abstract(self.draft_params)
                 dc_abs = _abstract(self.draft_cache)
@@ -884,6 +963,73 @@ class InferenceEngine:
                                    on_chunk) is None:
                 return None
         return int(tok)
+
+    def prefill_packed(self, rows, bucket: int):
+        """ONE packed prefill round: each entry of ``rows`` is a
+        ``(slot, chunk_ids, start, block_row, temperature, top_p, seed)``
+        tuple — request ``slot``'s NEXT prompt chunk (``chunk_ids``, at
+        most ``bucket`` tokens) at absolute position ``start`` through its
+        ``block_row`` — and all of them run in one (P, bucket) dispatch
+        (P = ``prefill_batch``; missing rows are inactive padding).
+
+        The caller (the scheduler's packed admission lane) owns the chunk
+        loop the sequential :meth:`prefill` runs internally: it computes
+        each row's next chunk with the SAME best-fit bucket discipline
+        ``_stream_chunks`` uses and groups rows by bucket, which is what
+        keeps per-row chunk shapes — and therefore the streams, on the
+        gather impl — bit-identical to sequential prefill. Returns one
+        sampled token id per row; only a row whose chunk was its prompt's
+        FINAL chunk has a meaningful token (the first generated token),
+        exactly like the sequential chunk loop's intermediate discards.
+
+        Prefix-cache divergent starts need nothing special here: a resumed
+        row simply arrives with ``start`` > 0 and a block row whose leading
+        entries are the shared blocks, as in sequential resumption."""
+        if self.kv_layout != "paged":
+            raise ValueError("packed prefill requires the paged KV layout")
+        if self.prefill_batch < 2:
+            raise ValueError("engine built without the packed prefill lane "
+                             "(prefill_batch < 2)")
+        bucket = int(bucket)
+        if bucket not in self.prefill_buckets:
+            raise ValueError(f"bucket {bucket} not in compiled set "
+                             f"{self.prefill_buckets}")
+        p = self.prefill_batch
+        if not 1 <= len(rows) <= p:
+            raise ValueError(f"{len(rows)} packed rows outside [1, {p}]")
+        toks = np.zeros((p, bucket), np.int32)
+        block_rows = np.zeros((p, self.max_blocks_per_slot), np.int32)
+        slots = np.zeros((p,), np.int32)
+        starts = np.zeros((p,), np.int32)
+        lens = np.zeros((p,), np.int32)
+        active = np.zeros((p,), bool)
+        temp = np.zeros((p,), np.float32)
+        tp = np.ones((p,), np.float32)
+        seeds = np.zeros((p,), np.int32)
+        for i, (slot, ids, start, row, temperature, top_p, seed) in \
+                enumerate(rows):
+            ids = np.asarray(ids, np.int32).reshape(-1)
+            if not 0 < ids.size <= bucket:
+                raise ValueError(f"packed row {i}: chunk length {ids.size} "
+                                 f"outside (0, {bucket}]")
+            row = np.asarray(row, np.int32).reshape(-1)
+            if row.shape[0] != self.max_blocks_per_slot:
+                raise ValueError(f"packed row {i}: block_row has "
+                                 f"{row.shape[0]} entries, expected "
+                                 f"{self.max_blocks_per_slot}")
+            toks[i, :ids.size] = ids
+            block_rows[i] = row
+            slots[i] = slot
+            starts[i] = start
+            lens[i] = ids.size
+            active[i] = True
+            temp[i] = temperature
+            tp[i] = top_p
+            seeds[i] = seed
+        self.cache, out = self._packed_prefill[bucket](
+            self.params, self.cache, block_rows, toks, slots, starts, lens,
+            active, temp, tp, seeds)
+        return [int(t) for t in np.asarray(out)[:len(rows)]]
 
     def decode_step(self, tokens, active, temperature, top_p, seeds, steps,
                     block_tables=None) -> np.ndarray:

@@ -36,6 +36,17 @@ see. Draft admission stays full-footprint; decode/spec rounds only ever
 write at positions >= prompt_len, which live in the slot's private blocks,
 so sharing never constrains them.
 
+With ``prefill_batch > 1`` (engine built to match) admission switches to
+the PACKED prefill lane: allocation keeps the exact sequential front-half
+(prefix acquire-first, COW, rollback), but prompts then stream through
+per-step packed ROUNDS — up to ``prefill_batch`` pending rows' next
+chunks, grouped on the head row's best-fit bucket, in ONE (P, bucket)
+dispatch — interleaved with the decode rounds instead of draining the
+queue one prompt at a time. Prefill work between two decode rounds is
+bounded by P * bucket tokens (Sarathi-style stall-free batching), and
+per-row chunk shapes match the sequential loop exactly, so packed streams
+stay bit-identical to sequential prefill on the gather impl.
+
 When the engine was built with a draft model (``spec_k > 0``) the
 scheduler runs SPECULATIVE rounds instead of single-token decode
 iterations: each round emits 1..k+1 tokens per slot (engine.py
@@ -191,6 +202,24 @@ class Completion:
         return decoded / dt if decoded > 0 and dt > 0 else 0.0
 
 
+@dataclasses.dataclass
+class _PendingPrefill:
+    """One admitted-but-not-yet-prefilled request in the PACKED prefill
+    lane (``prefill_batch > 1``): its slot and blocks are already owned
+    (allocation, prefix-cache references and the full-hit COW all happened
+    at admission, exactly as in the sequential lane), but the prompt
+    streams chunk-by-chunk through ``Scheduler._prefill_round`` — packed
+    with other rows into one dispatch per round — instead of draining
+    in one blocking ``engine.prefill`` call."""
+    request: Request
+    submitted_at: float
+    slot: int
+    row: np.ndarray         # full padded block-table row
+    blocks: List[int]       # every block to free exactly once on abort
+    start_pos: int          # prefix-resume offset (0 = no cache hit)
+    pos: int                # next absolute position to prefill
+
+
 class _Slot:
     def __init__(self, request: Request, first_token: int,
                  submitted_at: float, now: float):
@@ -212,7 +241,8 @@ class Scheduler:
                  clock: Callable[[], float] = time.monotonic,
                  registry: Optional[MetricRegistry] = None,
                  stop_check: Optional[Callable[[], bool]] = None,
-                 adaptive_k=None, decode_burst: int = 1):
+                 adaptive_k=None, decode_burst: int = 1,
+                 prefill_batch: int = 1, adaptive_burst: bool = False):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -260,6 +290,50 @@ class Scheduler:
                     "dispatches over k+1 tokens")
             if not hasattr(engine, "decode_burst"):
                 raise ValueError("engine does not implement decode_burst")
+        # Burst-aware adaptive n: under queue / pending-prefill pressure
+        # each step() scales the burst width DOWN (halving per waiting
+        # unit, floor 1) before the existing per-slot budget clamp, so a
+        # long burst never starves admission while the queue piles up —
+        # idle-queue steps still run the full configured width. The
+        # engine's compile-on-first-use burst ladder absorbs the handful
+        # of distinct widths this produces.
+        self.adaptive_burst = bool(adaptive_burst)
+        if self.adaptive_burst and self.decode_burst < 2:
+            raise ValueError("adaptive_burst requires decode_burst > 1 "
+                             "(there is no width to scale down)")
+        # Packed multi-request prefill (engine.prefill_packed): admission
+        # allocates slots/blocks as usual but ENQUEUES the prompt instead
+        # of streaming it to completion; each step() then dispatches ONE
+        # packed round — up to prefill_batch pending rows' next chunks in
+        # one (P, bucket) program — before the decode round, so prefill
+        # work between decode rounds is bounded by P * bucket tokens
+        # (Sarathi-style stall-free mixed batching) instead of a whole
+        # prompt per admission.
+        self.prefill_batch = int(prefill_batch)
+        self._pending_prefill: deque = deque()  # _PendingPrefill rows
+        if self.prefill_batch < 1:
+            raise ValueError(f"prefill_batch {prefill_batch} must be >= 1")
+        if self.prefill_batch > 1:
+            if self.kv_layout != "paged":
+                raise ValueError("prefill_batch > 1 requires the paged KV "
+                                 "layout")
+            if self.spec_k:
+                raise ValueError(
+                    "prefill_batch > 1 and speculative decoding are "
+                    "mutually exclusive (the draft prefill lifecycle is "
+                    "sequential; engine.py enforces the same)")
+            if not hasattr(engine, "prefill_packed"):
+                raise ValueError("engine does not implement prefill_packed")
+            if getattr(engine, "prefill_batch", 1) != self.prefill_batch:
+                raise ValueError(
+                    f"scheduler prefill_batch {self.prefill_batch} != "
+                    f"engine prefill_batch "
+                    f"{getattr(engine, 'prefill_batch', 1)}: the packed "
+                    f"programs were compiled at the engine's width")
+        self.prefill_packed_rounds = 0
+        self.prefill_packed_rows = 0
+        self.prefill_inplace_chunks = 0
+        self.prefill_gather_chunks = 0
         # Dispatch/sync accounting (the fused-decode win in receipts):
         # how many device programs were launched and how many host syncs
         # were paid for the decode tokens generated.
@@ -308,6 +382,19 @@ class Scheduler:
         self._m_chunks = r.counter(
             "ftl_serve_prefill_chunks_total",
             "Prefill chunks executed (chunked long-prompt prefill)")
+        self._m_prefill_batch = r.histogram(
+            "prefill_batch_size",
+            "Requests packed per packed-prefill dispatch (packed lane "
+            "only; capacity is --prefill-batch)",
+            buckets=SPEC_TOKEN_BUCKETS)
+        self._m_prefill_inplace = r.counter(
+            "prefill_inplace_total",
+            "Prefill chunks dispatched through the in-place Pallas paged "
+            "kernel (--paged-kernel pallas, S>1 chunk grid)")
+        self._m_prefill_gather = r.counter(
+            "prefill_gather_total",
+            "Prefill chunks dispatched through the gather-then-ring "
+            "reference kernel (--paged-kernel gather)")
         self._m_spec_draft = r.counter(
             "ftl_spec_draft_tokens_total",
             "Draft-model tokens proposed (speculative decoding)")
@@ -404,7 +491,8 @@ class Scheduler:
         self.admission_open = True
 
     def pending(self) -> bool:
-        return bool(self.active or (self.queue and self.admission_open))
+        return bool(self.active or self._pending_prefill
+                    or (self.queue and self.admission_open))
 
     def unserved(self) -> List[Request]:
         return [r for r, _ in self.queue]
@@ -442,12 +530,22 @@ class Scheduler:
     def _count_chunk(self) -> None:
         self.prefill_chunks += 1
         self._m_chunks.inc()
+        # which kernel the chunk's paged reads dispatched through — the
+        # serving-visible proof there is no silent gather under pallas
+        if getattr(self.engine, "paged_kernel", "gather") == "pallas":
+            self.prefill_inplace_chunks += 1
+            self._m_prefill_inplace.inc()
+        else:
+            self.prefill_gather_chunks += 1
+            self._m_prefill_gather.inc()
 
     def _drain_requested(self) -> bool:
         return self.stop_check is not None and bool(self.stop_check())
 
     def _admit(self, done: List[Completion]) -> None:
-        free = [s for s in range(self.engine.slots) if s not in self.active]
+        taken = set(self.active)
+        taken.update(p.slot for p in self._pending_prefill)
+        free = [s for s in range(self.engine.slots) if s not in taken]
         while free and self.queue:
             req, submitted_at = self.queue[0]
             blocks, dblocks = None, None
@@ -522,6 +620,18 @@ class Scheduler:
                 row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
                 row[:len(slot_blocks)] = slot_blocks
                 self.block_tables[slot] = row
+                if self.prefill_batch > 1:
+                    # PACKED lane: ownership established (blocks, prefix
+                    # references, full-hit COW all done above) — enqueue
+                    # the prompt for the chunk-interleaved rounds instead
+                    # of streaming it to completion here. Prefix insert /
+                    # hit accounting moves to the row's completion, where
+                    # the sequential lane does it too.
+                    self._pending_prefill.append(_PendingPrefill(
+                        request=req, submitted_at=submitted_at, slot=slot,
+                        row=row, blocks=slot_blocks, start_pos=start_pos,
+                        pos=start_pos))
+                    continue
                 spec_kw = {}
                 if self.spec_k:
                     drow = np.zeros((self.engine.max_blocks_per_slot,),
@@ -581,12 +691,98 @@ class Scheduler:
             elif req.max_new_tokens <= 1:
                 self._finish(slot, "length", done)
 
+    def _abort_pending_prefill(self) -> None:
+        """Drain landed while packed rows were mid-prompt: free every
+        pending row's blocks exactly once (fresh, COW and acquired shared
+        references alike — shared blocks survive under the cache's own
+        reference), requeue the requests at the head in admission order so
+        they are REPORTED unserved, and close admission — the sequential
+        lane's mid-chunk drain contract, at round granularity."""
+        for p in reversed(self._pending_prefill):
+            self.allocator.free(p.blocks)
+            self.block_tables[p.slot] = 0
+            self.queue.appendleft((p.request, p.submitted_at))
+        self._pending_prefill.clear()
+        self.stop_admission()
+
+    def _finish_prefill(self, p: _PendingPrefill, first: int,
+                        done: List[Completion]) -> None:
+        """A packed row's FINAL chunk landed: the round's sampled token is
+        its first generated token — promote the row to an active decode
+        slot (everything the sequential lane does after engine.prefill
+        returns, including the straight-out-of-prefill finish checks)."""
+        self._slot_blocks[p.slot] = p.blocks
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(p.request.prompt, p.blocks)
+            self.prefix_cache.note_admission(p.start_pos,
+                                             len(p.request.prompt))
+            self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
+        self.active[p.slot] = _Slot(p.request, first, p.submitted_at,
+                                    self.clock())
+        self.max_concurrent = max(self.max_concurrent, len(self.active))
+        self._m_tokens.inc()  # the prefill's first token
+        if (self.eos_token_id is not None
+                and first == self.eos_token_id):
+            self._finish(p.slot, "eos", done)
+        elif p.request.max_new_tokens <= 1:
+            self._finish(p.slot, "length", done)
+
+    def _prefill_round(self, done: List[Completion]) -> None:
+        """ONE packed prefill round: walk the pending rows in admission
+        order, take up to ``prefill_batch`` whose next chunk best-fits the
+        HEAD row's bucket (each row computes its chunk with exactly the
+        sequential ``_stream_chunks`` discipline — largest bucket while
+        the remainder exceeds it, best-fit on the final chunk — which is
+        what keeps per-row chunk shapes, and so the streams on the gather
+        impl, bit-identical to sequential prefill), and dispatch them in
+        one (P, bucket) program. Rows needing a different bucket stay
+        pending for a later round. The drain probe runs at round
+        boundaries, the packed analogue of the sequential lane's
+        between-chunk ``stop_check``."""
+        if self._drain_requested():
+            self._abort_pending_prefill()
+            return
+        chunk = self.engine.prefill_buckets[-1]
+        head_bucket = None
+        batch: List = []  # (row, chunk_len) pairs this round
+        for p in self._pending_prefill:
+            m = min(chunk, len(p.request.prompt) - p.pos)
+            bucket = next(b for b in self.engine.prefill_buckets if b >= m)
+            if head_bucket is None:
+                head_bucket = bucket
+            if bucket != head_bucket:
+                continue
+            batch.append((p, m))
+            if len(batch) == self.prefill_batch:
+                break
+        rows = [(p.slot,
+                 np.asarray(p.request.prompt[p.pos:p.pos + m], np.int32),
+                 p.pos, p.row, p.request.temperature, p.request.top_p,
+                 p.request.seed) for p, m in batch]
+        t0 = self.clock()
+        toks = self.engine.prefill_packed(rows, head_bucket)
+        self.prefill_seconds += self.clock() - t0
+        self.prefill_packed_rounds += 1
+        self.prefill_packed_rows += len(rows)
+        self._m_prefill_batch.observe(len(rows))
+        for (p, m), tok in zip(batch, toks):
+            self._count_chunk()
+            p.pos += m
+            if p.pos >= len(p.request.prompt):
+                self._pending_prefill.remove(p)
+                self._finish_prefill(p, tok, done)
+
     def step(self) -> List[Completion]:
         """Admit into free slots, run one decode iteration, evict finished
-        requests. Returns the completions produced by this iteration."""
+        requests. Returns the completions produced by this iteration.
+        In the packed-prefill lane, one packed chunk round runs before the
+        decode round, so admitted prompts and active decodes interleave
+        instead of prefill draining the queue first."""
         done: List[Completion] = []
         if self.admission_open:
             self._admit(done)
+        if self._pending_prefill:
+            self._prefill_round(done)
         self._m_queue.set(len(self.queue))
         self._m_occupancy.set(len(self.active) / max(self.engine.slots, 1))
         if self.kv_layout == "paged":
@@ -645,6 +841,12 @@ class Scheduler:
             # blocks (admission sized them for prompt + max_new_tokens);
             # EOS overshoot inside the burst is truncated at banking.
             n = self.decode_burst
+            if self.adaptive_burst:
+                # halve per unit of admission pressure so a burst never
+                # walls off the queue; the budget clamp below is unchanged
+                pressure = len(self.queue) + len(self._pending_prefill)
+                if pressure:
+                    n = max(1, n // (1 + pressure))
             for st in self.active.values():
                 n = min(n, st.request.max_new_tokens - len(st.tokens))
             n = max(int(n), 1)
@@ -803,7 +1005,7 @@ class Scheduler:
         which opts out of caching — must be fully free. Violations are
         audited ONCE (``[KV LEAK]``) through the flight recorder and, in
         strict mode, raised. Returns the violation descriptions."""
-        if self.kv_layout != "paged" or self.active:
+        if self.kv_layout != "paged" or self.active or self._pending_prefill:
             return []
         leaks: List[str] = []
         cached = (self.prefix_cache.cached_blocks
@@ -847,7 +1049,17 @@ class Scheduler:
             "tokens_per_sec_per_slot": tps / max(self.engine.slots, 1),
             "prefill_chunks": self.prefill_chunks,
             "prefill_seconds": self.prefill_seconds,
+            "prefill_batch": self.prefill_batch,
+            "prefill_packed_rounds": self.prefill_packed_rounds,
+            "prefill_packed_rows": self.prefill_packed_rows,
+            "prefill_packed_occupancy": (
+                self.prefill_packed_rows
+                / (self.prefill_packed_rounds * self.prefill_batch)
+                if self.prefill_packed_rounds else 0.0),
+            "prefill_inplace_chunks": self.prefill_inplace_chunks,
+            "prefill_gather_chunks": self.prefill_gather_chunks,
             "decode_burst": self.decode_burst,
+            "adaptive_burst": self.adaptive_burst,
             "decode_dispatches": self.decode_dispatches,
             "decode_host_syncs": self.decode_host_syncs,
             "decode_tokens": self.decode_tokens,

@@ -44,6 +44,7 @@ from ..utils.logging import (
     AUDIT_SERVE_COMPLETED,
     AUDIT_SERVE_DRAINED_FMT,
     AUDIT_SERVE_DRAINING_FMT,
+    AUDIT_SERVE_PREFILL_FMT,
     AUDIT_SERVE_PREFIX_FMT,
     AUDIT_SERVE_READY_FMT,
     AUDIT_SERVE_START,
@@ -179,6 +180,23 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "Admission/EOS eviction and the drain/stop probes "
                         "land at burst boundaries (at most n-1 tokens "
                         "later); mutually exclusive with --spec-k")
+    p.add_argument("--adaptive-burst", action="store_true",
+                   help="scale the burst width DOWN under queue / pending-"
+                        "prefill pressure (halving per waiting unit, floor "
+                        "1) so long bursts never starve admission; the "
+                        "existing per-slot budget clamp is unchanged. "
+                        "Requires --decode-burst > 1")
+    p.add_argument("--prefill-batch", type=int, default=1,
+                   help="packed multi-request prefill (paged layout): P > 1 "
+                        "packs up to P admitted requests' next prompt "
+                        "chunks — each at its own absolute offset and "
+                        "block-table row, prefix-cache resume offsets "
+                        "included — into ONE (P, bucket) AOT dispatch per "
+                        "scheduler step, interleaved with decode rounds "
+                        "instead of draining admission one prompt at a "
+                        "time. Streams stay bit-identical to sequential "
+                        "prefill on the gather impl; mutually exclusive "
+                        "with --spec-k")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the content-addressed prefix cache "
                         "(paged layout): admissions sharing a committed "
@@ -334,7 +352,8 @@ def main(argv=None) -> None:
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks or None,
             prefix_cache=not args.no_prefix_cache,
-            paged_kernel=args.paged_kernel, **spec_kwargs)
+            paged_kernel=args.paged_kernel,
+            prefill_batch=args.prefill_batch, **spec_kwargs)
         if args.spec_k:
             engine.draft_restored_step = draft_step_restored
             logger.info(
@@ -358,7 +377,9 @@ def main(argv=None) -> None:
                                         else tokenizer.eos_token_id),
                           stop_check=lambda: flag.signum is not None,
                           adaptive_k=adaptive,
-                          decode_burst=args.decode_burst)
+                          decode_burst=args.decode_burst,
+                          prefill_batch=args.prefill_batch,
+                          adaptive_burst=args.adaptive_burst)
         prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
                    ) * args.repeat
         for i, text in enumerate(prompts):
@@ -479,6 +500,22 @@ def main(argv=None) -> None:
             "acceptance %.3f", m["spec_k"], m["spec_rounds"],
             m["spec_draft_tokens"], m["spec_accepted_tokens"],
             m["spec_acceptance_rate"])
+    if sched.prefill_batch > 1:
+        # packed-lane occupancy in the drain receipt: how full the packed
+        # prefill dispatches ran, and which kernel their paged reads took
+        # (inplace under --paged-kernel pallas — no silent gather)
+        events.emit_audit(
+            logger, AUDIT_SERVE_PREFILL_FMT.format(
+                rounds=m["prefill_packed_rounds"],
+                rows=m["prefill_packed_rows"],
+                occupancy=m["prefill_packed_occupancy"],
+                inplace=m["prefill_inplace_chunks"],
+                gather=m["prefill_gather_chunks"]),
+            "packed_prefill", rounds=m["prefill_packed_rounds"],
+            rows=m["prefill_packed_rows"],
+            occupancy=m["prefill_packed_occupancy"],
+            inplace_chunks=m["prefill_inplace_chunks"],
+            gather_chunks=m["prefill_gather_chunks"])
     if sched.prefix_cache is not None:
         # hit rate rides the drain-summary audit trail: the receipt an
         # operator greps after a drain shows how much prefill the cache

@@ -31,12 +31,14 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16  # activations / compute
     param_dtype: jnp.dtype = jnp.bfloat16  # weights (and hence AdamW moments)
     attention_impl: str = "auto"
-    # Paged-KV attention kernel (serving decode path only; read where the
-    # cache is a block pool): "gather" assembles each slot's blocks into a
-    # contiguous view and runs the ring kernel on it (bit-exact reference),
-    # "pallas" reads pool blocks in place through the block table
-    # (ops/paged_attention.py — no gathered copy; equal to gather within
-    # fp32 accumulation tolerance). Training never reads this field.
+    # Paged-KV attention kernel (every serving read through block tables:
+    # S=1 decode AND S>1 chunked prefill / chunk-mode spec-verify):
+    # "gather" assembles each slot's blocks into a contiguous view and
+    # runs the ring kernel on it (bit-exact reference), "pallas" reads
+    # pool blocks in place through the block table — the decode kernel
+    # for S=1, the chunk kernel for S>1 (ops/paged_attention.py; no
+    # gathered copy either way; equal to gather within fp32 accumulation
+    # tolerance). Training never reads this field.
     paged_kernel: str = "gather"
     # Sequence layout under sequence parallelism: "zigzag" (each shard holds
     # one early + one mirrored late chunk — balances causal work around the

@@ -237,6 +237,11 @@ class Attention(nn.Module):
                 v_pool = write_paged_kv(
                     v_pool, jnp.transpose(v, (0, 2, 1, 3)), block_tables,
                     offsets, write_valid)
+                # paged_attention dispatches on (impl, S): under "pallas"
+                # both the S=1 decode read and S>1 chunk reads (chunked /
+                # packed prefill, chunk-mode spec-verify) stay in place —
+                # this batch-general path is also what the packed
+                # multi-request prefill program runs at B > 1.
                 from ..ops.attention import paged_attention
                 out = paged_attention(q, k_pool, v_pool, block_tables,
                                       offsets, impl=cfg.paged_kernel)

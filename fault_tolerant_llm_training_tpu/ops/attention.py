@@ -161,22 +161,15 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     - ``"gather"`` — :func:`paged_cached_attention`: gather-then-ring,
       the portable bit-exact reference (serving's ``--paged-kernel
       gather``).
-    - ``"pallas"`` — the in-place block-indexed kernel
+    - ``"pallas"`` — the in-place block-indexed kernels
       (ops/paged_attention.py): pool blocks are DMA'd straight through
-      the table, no gathered copy. Equal to gather within fp32
-      accumulation tolerance (online softmax reorders the reduction).
-      DECODE shapes only: for S > 1 this falls back to gather, because
-      the multi-token shapes don't pay the gather tax where it hurts —
-      chunked prefill runs once per prompt (and its S=bucket rows would
-      need the kernel to walk a q-position axis too), and the engine's
-      default ``"exact"`` spec-verify never issues an S=k+1 read at all
-      (it micro-steps S=1 forwards for bitwise greedy equality, so
-      spec-decode's verify DOES get the in-place read, one micro-step at
-      a time). The S>1 chunk-verify mode keeps the gather path's
-      documented masking: query row j at ``offsets[b] + j`` attends
-      ``k_pos <= offsets[b] + j`` — the committed prefix plus proposals
-      1..j — and rejected-suffix/stale positions are zeroed by the same
-      ``exp(finfo.min) == 0`` mask, no device-side rollback.
+      the table, no gathered copy. S=1 takes the decode kernel, S>1
+      (chunked prefill, chunk-mode spec-verify) the chunk kernel — every
+      paged read is in place under this impl, no silent gather. Both are
+      equal to gather within fp32 accumulation tolerance (online softmax
+      reorders the reduction) and bitwise invariant to masked bytes; the
+      single statement of the positional-masking equivalence lives in
+      ops/paged_attention.py's module docstring.
     """
     if impl == "gather":
         return paged_cached_attention(q, k_pool, v_pool, block_tables,
@@ -186,8 +179,9 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
             from .paged_attention import paged_decode_attention
             return paged_decode_attention(q, k_pool, v_pool, block_tables,
                                           offsets)
-        return paged_cached_attention(q, k_pool, v_pool, block_tables,
-                                      offsets)
+        from .paged_attention import paged_chunk_attention
+        return paged_chunk_attention(q, k_pool, v_pool, block_tables,
+                                     offsets)
     raise ValueError(f"unknown paged attention impl: {impl!r} "
                      f"(want 'gather' or 'pallas')")
 

@@ -1,10 +1,10 @@
-"""Pallas paged-attention decode kernel: block-indexed KV reads in place.
+"""Pallas paged-attention kernels: block-indexed KV reads in place.
 
 The XLA-level paged path (ops/attention.py ``paged_cached_attention``)
 gathers each slot's pool blocks into a transient contiguous (B, K, T, D)
 copy and runs the ring kernel's einsum on it — correct by construction,
-but the gather is an extra full-cache pass per layer per decode step.
-This module is the decode-side member of the repo's Pallas kernel family
+but the gather is an extra full-cache pass per layer per dispatch. This
+module is the serving-side member of the repo's Pallas kernel family
 (flash_attention.py prefill, ring_flash.py sequence-parallel): the block
 table rides in as a scalar-prefetch operand, the grid's innermost axis
 walks a slot's logical blocks, and each step's BlockSpec index map sends
@@ -12,17 +12,32 @@ the DMA straight at ``pool[tables[b, j]]`` — the pool is read THROUGH
 the table with no gathered intermediate, vLLM's PagedAttention fused
 with flash-decoding's split-KV online softmax.
 
-Masking reproduces the gather path's semantics exactly and entirely by
-position: a decode query at position ``offsets[b]`` attends keys at
-``k_pos <= offsets[b]``. Everything the gather path neutralizes with its
-additive ``finfo.min`` mask — null-block-0 garbage behind unallocated
-table entries, stale KV in freed-and-reused blocks, the written-ahead
-tail of a COW'd final block — sits past that boundary, so the same
-comparison excludes it here: masked lanes get ``exp2(NEG_INF - m) == 0``
-probability exactly, and blocks that start past the boundary are skipped
-wholesale (``@pl.when``), never touching the accumulator. Shared prefix
-blocks need no handling at all: a block referenced by several rows is
-simply DMA'd for each, same bytes.
+Two kernels share that structure, dispatched by ``ops/attention.py
+paged_attention(impl=)`` on the query length:
+
+- :func:`paged_decode_attention` — S = 1 (the decode step; the query
+  row is the slot's GQA group, (G, D)).
+- :func:`paged_chunk_attention` — S > 1 (chunked prefill, chunk-mode
+  spec-verify): the same grid, the q block widened to the chunk's
+  S*G rows, the causal boundary applied per row.
+
+MASKING (the single statement of the rationale, for both kernels and
+for the gather reference that ops/attention.py keeps selectable):
+everything is positional. A query at absolute position ``p`` attends
+keys at ``k_pos <= p`` — decode has one position per slot
+(``offsets[b]``), a chunk has ``offsets[b] + s`` for its s-th row.
+Everything the gather path neutralizes with its additive ``finfo.min``
+mask — null-block-0 garbage behind unallocated table entries, stale KV
+in freed-and-reused blocks, the written-ahead tail of a COW'd final
+block, the unwritten pad tail of a partial prefill chunk — sits past
+that per-row boundary, so the same comparison excludes it here: masked
+lanes get ``exp2(NEG_INF - m) == 0`` probability exactly, and blocks
+that start past the LAST row's boundary are skipped wholesale
+(``@pl.when``), never touching the accumulator. The output is therefore
+bitwise invariant to the bytes in masked positions (asserted,
+tests/test_paged_kernel.py). Shared prefix blocks need no handling at
+all: a block referenced by several rows is simply DMA'd for each, same
+bytes.
 
 Numerics follow the house flash-decoding scheme (flash_attention.py):
 base-2 online softmax with ``log2(e)`` folded into the q prescale, fp32
@@ -32,10 +47,6 @@ the gather path's full-row softmax — equality holds to fp32 accumulation
 tolerance, not bitwise, which is why the engine keeps the gather program
 selectable as the bit-exact reference (``--paged-kernel gather``).
 
-Decode-specialized: S = 1 per slot (the query row is the slot's GQA
-group, (G, D)). Multi-token shapes — chunked prefill, chunk-mode
-spec-verify — stay on the gather path (ops/attention.py
-``paged_attention`` routes; its docstring carries the argument).
 Runs under ``interpret=True`` off-TPU like every kernel here, so tier-1
 asserts the equivalence on CPU (tests/test_paged_kernel.py).
 """
@@ -131,8 +142,9 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     b, s_q, h, d = q.shape
     if s_q != 1:
         raise ValueError(f"paged_decode_attention is S=1-specialized, got "
-                         f"S={s_q} (multi-token shapes take the gather "
-                         f"path — ops/attention.py paged_attention)")
+                         f"S={s_q} (multi-token shapes take "
+                         f"paged_chunk_attention — ops/attention.py "
+                         f"paged_attention routes)")
     n, kv, bs, _ = k_pool.shape
     g = h // kv
     nb = block_tables.shape[1]
@@ -168,3 +180,132 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         interpret=_interpret() if interpret is None else interpret,
     )(tables, offs, qg, k_pool, v_pool)
     return out.reshape(b, 1, h, d)
+
+
+def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, block_size: int, group: int,
+                  s_q: int, scale: float):
+    """One (slot b, kv-head h, logical block j) grid step, S > 1 rows.
+
+    The q block is the chunk's S*G rows for this kv head, s-major: row r
+    is query position ``offsets[b] + r // group``, group member
+    ``r % group``. Same online-softmax carry as :func:`_decode_kernel`,
+    but the causal boundary is applied PER ROW — one iota-derived q_pos
+    column against the block's k_pos row — and the wholesale block skip
+    keys off the LAST row's boundary (a block any row can see must run;
+    rows that can't see it get every lane masked, exp2 underflows to 0.0
+    exactly, their carry is untouched).
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    offset = offs_ref[b]  # this slot's chunk start (first row's position)
+
+    @pl.when(j * block_size <= offset + (s_q - 1))
+    def _block():
+        rows = s_q * group
+        q2 = (q_ref[0, 0].astype(jnp.float32)
+              * (scale * LOG2E)).astype(q_ref.dtype)       # (rows, D)
+        s = jax.lax.dot_general(                           # (rows, bs) fp32
+            q2, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1)
+        q_pos = offset + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 0) // group
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = (acc_scr[...] * alpha[:, None]
+                        + jax.lax.dot_general(
+                            p.astype(v_ref.dtype), v_ref[0, 0],
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        # l >= exp2(0) for every row: k_pos = 0 satisfies the row's own
+        # boundary (offset >= 0), and block 0 always runs.
+        o_ref[0, 0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                          v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                          offsets: jnp.ndarray,
+                          interpret: bool = None) -> jnp.ndarray:
+    """S>1 GQA paged attention reading pool blocks in place via the table.
+
+    The multi-token counterpart of :func:`paged_decode_attention` for
+    chunked prefill and chunk-mode spec-verify: same scalar-prefetched
+    (B, K, NB) grid, but the q block carries the chunk's S*G rows and the
+    causal mask is per row (``k_pos <= offsets[b] + s`` for the chunk's
+    s-th query — exactly ``cached_attention``'s additive mask, stated
+    positionally; module docstring has the full equivalence argument).
+
+    q:            (B, S, H, D) chunk queries (rope applied, KV written).
+    k/v_pool:     (N, K, bs, D) global block pools.
+    block_tables: (B, NB) int32 per-slot tables (0 = null block).
+    offsets:      (B,) int32 — row s of slot b sits at absolute position
+                  ``offsets[b] + s``.
+
+    Returns (B, S, H, D), equal to ``paged_cached_attention`` on the same
+    operands to fp32 accumulation tolerance (pad rows past a partial
+    chunk's valid length read the same unwritten pool bytes both paths
+    read — callers discard those rows).
+    """
+    b, s_q, h, d = q.shape
+    if s_q < 2:
+        raise ValueError(f"paged_chunk_attention wants S > 1, got S={s_q} "
+                         f"(S=1 is paged_decode_attention's shape)")
+    n, kv, bs, _ = k_pool.shape
+    g = h // kv
+    nb = block_tables.shape[1]
+    rows = s_q * g
+    # s-major rows per kv head: (B, S, K, G, D) -> (B, K, S*G, D), so row
+    # r is (position r // g, group member r % g) — what the kernel's
+    # per-row q_pos iota assumes.
+    qr = (q.reshape(b, s_q, kv, g, d)
+          .transpose(0, 2, 1, 3, 4).reshape(b, kv, rows, d))
+    tables = block_tables.reshape(-1).astype(jnp.int32)
+    offs = offsets.astype(jnp.int32)
+    kernel = functools.partial(_chunk_kernel, block_size=bs, group=g,
+                               s_q=s_q, scale=1.0 / math.sqrt(d))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, d),
+                             lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, bs, d),
+                             lambda bi, hi, j, t, o: (t[bi * nb + j],
+                                                      hi, 0, 0)),
+                pl.BlockSpec((1, 1, bs, d),
+                             lambda bi, hi, j, t, o: (t[bi * nb + j],
+                                                      hi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, d),
+                                   lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, _STAT_LANES), jnp.float32),  # m
+                pltpu.VMEM((rows, _STAT_LANES), jnp.float32),  # l
+                pltpu.VMEM((rows, d), jnp.float32),            # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, rows, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(tables, offs, qr, k_pool, v_pool)
+    return (out.reshape(b, kv, s_q, g, d)
+            .transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, d))

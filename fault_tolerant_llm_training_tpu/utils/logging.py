@@ -73,6 +73,9 @@ AUDIT_SERVE_PREFIX_FMT = ("Prefix cache | lookups {lookups} | hit rate "
                           "{rate:.3f} | hit tokens {hit_tokens} | cached "
                           "blocks {cached} | cow copies {cow} | evictions "
                           "{evictions}")
+AUDIT_SERVE_PREFILL_FMT = ("Packed prefill | rounds {rounds} | rows {rows} "
+                           "| occupancy {occupancy:.3f} | inplace chunks "
+                           "{inplace} | gather chunks {gather}")
 AUDIT_KV_LEAK_FMT = ("[KV LEAK] {pool} pool: {leaked} block(s) leaked "
                      "after drain ({used} allocated, {cached} "
                      "prefix-cached)")

@@ -23,7 +23,13 @@
 #    burst-n point spends <= 1/n + eps dispatches AND host syncs per
 #    token, and the fused sampling epilogue's greedy streams are
 #    bit-identical to the unfused host-sampled baseline (throughput
-#    numbers are machine-dependent and not pinned).
+#    numbers are machine-dependent and not pinned);
+# 5. mixed_prefill bench — re-runs the packed-prefill scenario and pins
+#    the BENCH_prefill_packed_cpu.json acceptance bars: packed streams
+#    bit-match sequential within each kernel, decode rounds ran between
+#    packed rounds, packed occupancy reached 1.0 on the full wave, and
+#    packed prefill wall-clock beats sequential on the gather lane
+#    (the speedup magnitude is machine-dependent; >= 1x is the bar).
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -126,4 +132,36 @@ print(f"ok: burst {got['burst_ns']} dispatches/token bounded by 1/n + "
       f"host-sampled bitwise")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, prefix bench, fused decode)"
+echo "== mixed_prefill bench vs committed receipt"
+python scripts/decode_bench.py --scenario mixed_prefill \
+    --out "$WORK/bench_packed.json"
+python - "$WORK/bench_packed.json" BENCH_prefill_packed_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+for p in got["points"]:
+    assert p["streams_bitmatch_sequential"], (
+        f"{p['kernel']} {p['mode']}: packed diverged from sequential")
+    if p["mode"] == "packed":
+        assert p["packed_occupancy"] == 1.0, (
+            f"{p['kernel']}: full-wave occupancy {p['packed_occupancy']} "
+            f"< 1.0")
+        assert p["prefill_speedup_vs_sequential"] >= 1.0, (
+            f"{p['kernel']}: packed prefill slower than sequential "
+            f"({p['prefill_speedup_vs_sequential']}x)")
+    expect_inplace = p["prefill_chunks"] if p["kernel"] == "pallas" else 0
+    assert p["prefill_inplace_chunks"] == expect_inplace, (
+        f"{p['kernel']} {p['mode']}: in-place chunk counter "
+        f"{p['prefill_inplace_chunks']} != {expect_inplace} — the wrong "
+        f"kernel served the chunks")
+assert got["decode_between_packed_rounds"], (
+    "no decode round ran between packed prefill rounds")
+assert want["decode_between_packed_rounds"], "committed receipt is stale"
+print(f"ok: packed == sequential bitwise on both kernels, gather lane "
+      f"{got['value']}x sequential prefill (>= 1x), decode interleaved "
+      f"with packed rounds")
+EOF
+
+echo "OK: nightly green (slow suite, chaos survival, prefix bench, fused decode, packed prefill)"

@@ -57,7 +57,8 @@ def main():
     p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
     p.add_argument("--scenario", default="uniform",
                    choices=("uniform", "long_context", "spec_decode",
-                            "shared_prefix", "fused_decode"))
+                            "shared_prefix", "fused_decode",
+                            "mixed_prefill"))
     p.add_argument("--burst-ns", default="1,4,8",
                    help="fused_decode scenario: comma-separated burst "
                         "lengths (tokens per dispatch) to sweep")
@@ -141,6 +142,8 @@ def main():
         result = _shared_prefix(args, vocab)
     elif args.scenario == "fused_decode":
         result = _fused_decode(args, vocab)
+    elif args.scenario == "mixed_prefill":
+        result = _mixed_prefill(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -149,7 +152,8 @@ def main():
     default_name = {"long_context": "BENCH_decode_paged",
                     "spec_decode": "BENCH_decode_spec",
                     "shared_prefix": "BENCH_decode_prefix",
-                    "fused_decode": "BENCH_decode_fused"}.get(
+                    "fused_decode": "BENCH_decode_fused",
+                    "mixed_prefill": "BENCH_prefill_packed"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -733,6 +737,181 @@ def _fused_decode(args, vocab):
         "fused_decode_seconds": round(fused_s, 4),
         "unfused_decode_seconds": round(unfused_s, 4),
         "fused_vs_unfused_speedup": round(unfused_s / fused_s, 2),
+        "points": points,
+    }
+
+
+def _mixed_prefill(args, vocab):
+    """Batched multi-request prefill: packed (P, bucket) rounds vs the
+    sequential one-prompt-at-a-time lane, across both paged kernels.
+
+    Two workloads per kernel (gather, pallas), one engine each (compiled
+    with BOTH the sequential bucket ladder and the packed programs, so
+    the two lanes share every byte of weights and cache):
+
+    - prefill wall-clock: N multi-chunk prompts served packed
+      (``prefill_batch=P``) and sequentially (``prefill_batch=1``)
+      through the SAME engine. Token streams are ASSERTED bit-identical
+      within each kernel — the packed batch is a parallel GEMM dimension
+      and every row walks the same chunk buckets, so packing cannot
+      change bytes. Across kernels, greedy mismatches are RECORDED, not
+      asserted (the in-place chunk kernel's online softmax reorders the
+      fp32 reduction — the fused_decode caveat). ``prefill_seconds`` is
+      the scheduler's own accumulator, timed around the prefill
+      dispatches only, so decode cost cannot smear it; each point takes
+      the min over repeats.
+    - decode under prefill load: short requests decode while long
+      prompts stream through the packed lane. A packed round is BOUNDED
+      (at most P x bucket positions per dispatch), so decode rounds run
+      BETWEEN packed rounds — asserted from a dispatch timeline — and
+      the receipt records the decode-iteration latency percentiles paid
+      under that load.
+
+    Headline value: packed-vs-sequential prefill wall-clock speedup at
+    N concurrent requests on the gather kernel (the bit-exact lane).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    # seq_len=256 for the RoPE table (tiny preset ships 128)
+    cfg = get_config(args.model, vocab_size=vocab, seq_len=256)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    slots, bs, pb = 8, 16, 4
+    n = slots                                  # one full concurrent wave
+    prompt_len, gen = 96, 16                   # 3 chunks each (32, 32, 32)
+    max_len = prompt_len + gen + bs
+    lrng = np.random.default_rng(args.seed + 41)
+    prompts = [lrng.integers(3, vocab, size=prompt_len).tolist()
+               for _ in range(n)]
+    sampling = [(0.0, 1.0, 0)] * (n - 2) + [(0.8, 0.9, 7), (0.7, 0.9, 11)]
+
+    def wave():
+        return [Request(id=f"r{i}", prompt=list(prompts[i]),
+                        max_new_tokens=gen, temperature=t, top_p=tp,
+                        seed=sd)
+                for i, (t, tp, sd) in enumerate(sampling)]
+
+    def run(engine, prefill_batch, requests):
+        engine.reset()
+        sched = Scheduler(engine, eos_token_id=None,
+                          prefill_batch=prefill_batch)
+        for r in requests:
+            sched.submit(r)
+        t0 = time.monotonic()
+        out = sched.run()
+        m = sched.metrics()
+        m["wall_seconds"] = time.monotonic() - t0
+        return m, {c.request_id: c.tokens for c in out}
+
+    repeats = 3
+    points = []
+    gather_streams = gather_engine = None
+    headline = None
+    for kernel in ("gather", "pallas"):
+        engine = InferenceEngine(cfg, params, slots=slots, max_len=max_len,
+                                 prefill_buckets=(16, 32),
+                                 kv_layout="paged", kv_block_size=bs,
+                                 paged_kernel=kernel, prefill_batch=pb)
+        run(engine, pb, wave())                # warm every program
+        run(engine, 1, wave())
+        best, streams = {}, {}
+        for mode, p in (("sequential", 1), ("packed", pb)):
+            for _ in range(repeats):
+                m, s = run(engine, p, wave())
+                if (mode not in best or m["prefill_seconds"]
+                        < best[mode]["prefill_seconds"]):
+                    best[mode] = m
+                streams[mode] = s
+        assert streams["packed"] == streams["sequential"], (
+            f"packed prefill diverged from sequential ({kernel})")
+        if kernel == "gather":
+            gather_streams, gather_engine = streams["sequential"], engine
+            mismatched = 0
+        else:
+            mismatched = sum(streams["sequential"][r] != gather_streams[r]
+                             for r in gather_streams)
+        speedup = (best["sequential"]["prefill_seconds"]
+                   / best["packed"]["prefill_seconds"])
+        if kernel == "gather":
+            headline = speedup
+        for mode in ("sequential", "packed"):
+            m = best[mode]
+            points.append({
+                "kernel": kernel,
+                "mode": mode,
+                "prefill_seconds": round(m["prefill_seconds"], 4),
+                "prefill_chunks": m["prefill_chunks"],
+                "prefill_inplace_chunks": m["prefill_inplace_chunks"],
+                "packed_rounds": m["prefill_packed_rounds"],
+                "packed_occupancy": round(m["prefill_packed_occupancy"], 3),
+                "tokens_per_sec": round(m["tokens_per_sec"], 1),
+                "streams_bitmatch_sequential": True,   # asserted above
+                "greedy_mismatch_vs_gather": mismatched,
+            })
+        points[-1]["prefill_speedup_vs_sequential"] = round(speedup, 2)
+        if kernel == "pallas":
+            engine = None
+
+    # decode under prefill load: 4 shorts prefill in round 1 and decode
+    # while the 4 long prompts stream through the remaining packed rounds
+    eng = gather_engine
+    timeline = []
+    orig_pp, orig_ds = eng.prefill_packed, eng.decode_step
+
+    def spy_pp(*a, **k):
+        timeline.append("P")
+        return orig_pp(*a, **k)
+
+    def spy_ds(*a, **k):
+        timeline.append("D")
+        return orig_ds(*a, **k)
+
+    eng.prefill_packed, eng.decode_step = spy_pp, spy_ds
+    mixed = ([Request(id=f"s{i}",
+                      prompt=lrng.integers(3, vocab, size=16).tolist(),
+                      max_new_tokens=40) for i in range(4)]
+             + [Request(id=f"l{i}", prompt=list(prompts[i]),
+                        max_new_tokens=8) for i in range(4)])
+    eng.reset()
+    sched = Scheduler(eng, eos_token_id=None, prefill_batch=pb)
+    for r in mixed:
+        sched.submit(r)
+    sched.run()
+    lm = sched.metrics()
+    eng.prefill_packed, eng.decode_step = orig_pp, orig_ds
+    first_p = timeline.index("P")
+    last_p = len(timeline) - 1 - timeline[::-1].index("P")
+    decode_between = "D" in timeline[first_p:last_p]
+    assert decode_between, ("no decode round ran between packed prefill "
+                            "rounds — the bounded-round interleave broke")
+
+    return {
+        "metric": (f"packed prefill speedup vs sequential at N={n} "
+                   f"({args.model}, prompt {prompt_len}, {slots} slots, "
+                   f"prefill_batch {pb}, gather kernel, backend "
+                   f"{jax.default_backend()})"),
+        "value": round(headline, 2),
+        "unit": "x sequential prefill seconds (same engine, same streams)",
+        "requests": n,
+        "prefill_batch": pb,
+        "prompt_len": prompt_len,
+        "prefill_buckets": [16, 32],
+        "decode_between_packed_rounds": decode_between,
+        "decode_under_prefill_load_p50_ms": round(lm["decode_p50_ms"], 3),
+        "decode_under_prefill_load_p95_ms": round(lm["decode_p95_ms"], 3),
+        "decode_under_prefill_load_requests": lm["requests_completed"],
         "points": points,
     }
 

@@ -255,6 +255,72 @@ def check_paged_decode_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64,
     return ok
 
 
+def check_paged_chunk_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64, s_q=8,
+                             dtype=jnp.bfloat16):
+    """Pallas paged-chunk kernel (S > 1: chunked/packed prefill, chunk-mode
+    spec-verify) vs the gather reference, compiled on the chip, over the
+    same adversarial pool matrix as the decode check but with each slot's
+    chunk STARTING at its offset — boundary-straddling chunks, stale table
+    tails past the last row, shared prefix blocks. Also pins the masked-byte
+    invariance compiled: rewriting every pool byte outside the rows' live
+    sets must not move the output by a single bit."""
+    from fault_tolerant_llm_training_tpu.ops.attention import (
+        paged_cached_attention,
+    )
+    from fault_tolerant_llm_training_tpu.ops.paged_attention import (
+        paged_chunk_attention,
+    )
+
+    rng = np.random.default_rng(4)
+    n_pool = slots * nb + 4
+    np_k = rng.standard_normal((n_pool, kv, bs, d))
+    np_v = rng.standard_normal((n_pool, kv, bs, d))
+    perm = rng.permutation(np.arange(1, slots * nb + 1))
+    tables = perm.reshape(slots, nb).astype(np.int32)
+    # offsets are chunk STARTS; rows reach offsets[b] + s_q - 1
+    offsets = rng.integers(0, nb * bs - s_q, size=slots).astype(np.int32)
+    offsets[0] = 2 * bs                     # chunk starts ON a boundary
+    offsets[1] = bs - s_q // 2              # chunk STRADDLES a boundary
+    for b in range(slots):                  # free blocks past the last row
+        tables[b, (int(offsets[b]) + s_q - 1) // bs + 1:] = 0
+    tables[2, -1] = n_pool - 1              # stale entry at an orphan block
+    tables[3, :2] = tables[2, :2]           # shared prefix rows
+    q = jnp.asarray(rng.standard_normal((slots, s_q, h, d)), dtype)
+    pool_k, pool_v = jnp.asarray(np_k, dtype), jnp.asarray(np_v, dtype)
+    jtables, joffsets = jnp.asarray(tables), jnp.asarray(offsets)
+
+    want = jax.jit(paged_cached_attention)(q, pool_k, pool_v, jtables,
+                                           joffsets)
+    got = jax.jit(paged_chunk_attention)(q, pool_k, pool_v, jtables,
+                                         joffsets)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(want.astype(jnp.float32)))) or 1.0
+
+    live = np.zeros((n_pool, bs), bool)
+    for b in range(slots):
+        for i in range(nb):
+            for lane in range(bs):
+                if i * bs + lane <= int(offsets[b]) + s_q - 1:
+                    live[tables[b, i], lane] = True
+    mask = live[:, None, :, None]
+    k2 = jnp.asarray(np.where(mask, np_k, rng.standard_normal(np_k.shape)),
+                     dtype)
+    v2 = jnp.asarray(np.where(mask, np_v, rng.standard_normal(np_v.shape)),
+                     dtype)
+    got2 = jax.jit(paged_chunk_attention)(q, k2, v2, jtables, joffsets)
+    invariant = bool(jnp.array_equal(got, got2))
+
+    ok = err / scale < 2e-2 and invariant
+    print(json.dumps({
+        "check": (f"paged_chunk_vs_gather_onchip slots={slots} kv={kv} "
+                  f"h={h} bs={bs} nb={nb} d={d} s_q={s_q}"),
+        "max_abs_err": err, "rel": err / scale,
+        "masked_bytes_bitwise_invariant": invariant, "ok": ok,
+    }), flush=True)
+    return ok
+
+
 def main():
     ok = True
     ok &= check_flash_parity(2048, 12, 12, 64)   # resident, bench shape
@@ -273,6 +339,8 @@ def main():
     ok &= check_ring_carry_64k(s=32768, sp=4, h=2, kv=2, d=128)
     ok &= check_paged_decode_parity()                       # serving, D=64
     ok &= check_paged_decode_parity(h=8, kv=4, d=128)       # flagship width
+    ok &= check_paged_chunk_parity()                        # S>1 chunk, D=64
+    ok &= check_paged_chunk_parity(h=8, kv=4, d=128)        # flagship width
     sys.exit(0 if ok else 1)
 
 

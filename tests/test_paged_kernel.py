@@ -3,18 +3,19 @@ ops/attention.py dispatch, inference/engine.py, inference/scheduler.py).
 
 Evidence ladder for the in-place decode path:
 
-1. kernel — the Pallas block-indexed kernel run in interpret mode equals the
-   gather-then-attend reference within fp32 accumulation tolerance over
-   ADVERSARIAL pool states (garbage null block, freed entries fallen back to
-   0, stale table entries aimed at orphaned garbage blocks, prefix-cache rows
-   sharing blocks, a copy-on-write final block, offsets landing exactly on
-   block boundaries), and its output is BITWISE invariant to the bytes in
-   masked blocks — stale content cannot leak through the online softmax;
+1. kernel — the Pallas block-indexed kernels (S=1 decode and S>1 chunk) run
+   in interpret mode equal the gather-then-attend reference within fp32
+   accumulation tolerance over ADVERSARIAL pool states (garbage null block,
+   freed entries fallen back to 0, stale table entries aimed at orphaned
+   garbage blocks, prefix-cache rows sharing blocks, a copy-on-write final
+   block, offsets landing exactly on block boundaries, chunks straddling
+   block boundaries), and their output is BITWISE invariant to the bytes in
+   masked positions — stale content cannot leak through the online softmax;
 2. dispatch — ``paged_attention`` routes "gather" bit-exactly, routes
-   "pallas" to the kernel only for decode (S == 1) shapes, falls back to
-   gather for S > 1, rejects unknown impls; ``multihead_attention`` accepts
-   the "ring" impl configs.py admits and resolves it to the dense equivalent
-   instead of raising;
+   "pallas" by query length (S == 1 -> decode kernel, S > 1 -> chunk kernel;
+   the former silent gather fallback for S > 1 is gone), rejects unknown
+   impls; ``multihead_attention`` accepts the "ring" impl configs.py admits
+   and resolves it to the dense equivalent instead of raising;
 3. engine — the fused sampling epilogue's token stream bit-matches the
    unfused baseline (sync full logits, sample on host with the SAME
    sampler.py function) for greedy and seeded sampled slots alike;
@@ -119,8 +120,88 @@ def test_pallas_kernel_rejects_multi_query():
         paged_decode_attention(q3, pk, pv, tables, offs)
 
 
+def _adversarial_chunk_pool(rng, s_q=5, dtype=np.float32):
+    """Four slots mid-prefill, each an adversarial S>1 chunk geometry.
+
+    slot 0: chunk starts exactly ON a block boundary (offset == 2*bs)
+    slot 1: chunk STRADDLES a block boundary (rows span blocks 0 and 1);
+            the table tail entry is stale, aimed at an orphaned garbage
+            block that starts past the LAST row — must be skipped wholesale
+    slot 2: prefix-cache row — shares its first two blocks with slot 3
+    slot 3: same shared prefix, but its final block is a copy-on-write
+            private copy of slot 2's that diverges in the rows the chunk
+            actually lands on
+    """
+    K, H, bs, NB, D = 2, 4, 8, 4, 16
+    B = 4
+    N = 16                                    # pool blocks incl. null block 0
+    pool_k = rng.standard_normal((N, K, bs, D)).astype(dtype)
+    pool_v = rng.standard_normal((N, K, bs, D)).astype(dtype)
+
+    tables = np.zeros((B, NB), np.int32)
+    tables[0] = [1, 2, 3, 0]
+    tables[1] = [4, 5, 14, 0]                 # 14 stale: past the last row
+    tables[2] = [6, 7, 8, 0]                  # shared prefix: blocks 6, 7
+    tables[3] = [6, 7, 9, 0]                  # COW copy of block 8 -> block 9
+    pool_k[9], pool_v[9] = pool_k[8].copy(), pool_v[8].copy()
+    pool_k[9, :, -3:], pool_v[9, :, -3:] = 0.25, -0.5   # diverged tail
+
+    offsets = np.array([2 * bs, bs - 2, 2 * bs + 1, 2 * bs + 3], np.int32)
+    q = rng.standard_normal((B, s_q, H, D)).astype(dtype)
+    return q, pool_k, pool_v, tables, offsets
+
+
+@pytest.mark.parametrize("s_q", [2, 5])
+def test_pallas_chunk_kernel_matches_gather_on_adversarial_pools(s_q):
+    rng = np.random.default_rng(14)
+    q, pk, pv, tables, offs = _adversarial_chunk_pool(rng, s_q=s_q)
+    ref = _attend(q, pk, pv, tables, offs, "gather")
+    out = _attend(q, pk, pv, tables, offs, "pallas")
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_chunk_kernel_output_invariant_to_masked_bytes():
+    """Rewrite every pool byte outside the union of the rows' live sets —
+    the null block, the orphaned stale block, every lane past each chunk's
+    last row (k_pos > offsets[b] + S - 1 for the owning slot) — and the
+    chunk kernel output must not move by a single bit. The live set is
+    per-ROW: a lane is live iff SOME slot's boundary admits it, which is
+    exactly the union the per-row causal mask protects."""
+    rng = np.random.default_rng(15)
+    q, pk, pv, tables, offs = _adversarial_chunk_pool(rng)
+    base = _attend(q, pk, pv, tables, offs, "pallas")
+
+    s_q = q.shape[1]
+    n, _, bs, _ = pk.shape
+    live = np.zeros((n, bs), bool)
+    for b in range(tables.shape[0]):
+        for i in range(tables.shape[1]):
+            for lane in range(bs):
+                if i * bs + lane <= int(offs[b]) + s_q - 1:
+                    live[tables[b, i], lane] = True
+    pk2 = np.where(live[:, None, :, None], pk,
+                   rng.standard_normal(pk.shape).astype(pk.dtype))
+    pv2 = np.where(live[:, None, :, None], pv,
+                   rng.standard_normal(pv.shape).astype(pv.dtype))
+    assert not np.array_equal(pk2, pk)       # the rewrite actually happened
+    np.testing.assert_array_equal(
+        _attend(q, pk2, pv2, tables, offs, "pallas"), base)
+
+
+def test_pallas_chunk_kernel_rejects_single_query():
+    from fault_tolerant_llm_training_tpu.ops.paged_attention import (
+        paged_chunk_attention)
+
+    rng = np.random.default_rng(16)
+    q, pk, pv, tables, offs = _adversarial_pool(rng)    # S == 1 shapes
+    with pytest.raises(ValueError, match="S > 1"):
+        paged_chunk_attention(q, pk, pv, tables, offs)
+
+
 # ------------------------------------------------------------------ 2. dispatch
-def test_paged_attention_dispatch_routes_and_validates():
+def test_paged_attention_dispatch_routes_and_validates(monkeypatch):
+    from fault_tolerant_llm_training_tpu.ops import (
+        paged_attention as pa_mod)
     from fault_tolerant_llm_training_tpu.ops.attention import (
         paged_cached_attention)
 
@@ -133,11 +214,26 @@ def test_paged_attention_dispatch_routes_and_validates():
     # "gather" IS paged_cached_attention, bitwise
     np.testing.assert_array_equal(_attend(q, pk, pv, tables, offs, "gather"),
                                   ref)
-    # "pallas" with S > 1 falls back to the gather path, bitwise
-    q3 = rng.standard_normal((4, 3, 4, 16)).astype(np.float32)
-    np.testing.assert_array_equal(
-        _attend(q3, pk, pv, tables, offs, "pallas"),
-        _attend(q3, pk, pv, tables, offs, "gather"))
+    # "pallas" dispatches on S: the decode kernel for S == 1, the chunk
+    # kernel for S > 1 — no silent gather fallback. Prove the route (spy on
+    # the kernel entry points) AND the result (fp32-close to gather; online
+    # softmax reorders the reduction, so closeness, not bitwise).
+    routed = []
+    for name in ("paged_decode_attention", "paged_chunk_attention"):
+        orig = getattr(pa_mod, name)
+        monkeypatch.setattr(
+            pa_mod, name,
+            lambda *a, _orig=orig, _n=name, **k: (routed.append(_n),
+                                                  _orig(*a, **k))[1])
+    np.testing.assert_allclose(_attend(q, pk, pv, tables, offs, "pallas"),
+                               ref, rtol=1e-5, atol=1e-6)
+    qc, pkc, pvc, tablesc, offsc = _adversarial_chunk_pool(
+        np.random.default_rng(17), s_q=3)
+    np.testing.assert_allclose(
+        _attend(qc, pkc, pvc, tablesc, offsc, "pallas"),
+        _attend(qc, pkc, pvc, tablesc, offsc, "gather"),
+        rtol=1e-5, atol=1e-6)
+    assert routed == ["paged_decode_attention", "paged_chunk_attention"]
     with pytest.raises(ValueError, match="impl"):
         _attend(q, pk, pv, tables, offs, "vllm")
 

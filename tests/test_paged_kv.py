@@ -17,7 +17,14 @@ Evidence ladder for the block-paged serving cache:
 4. scheduler — admission by free-block count queues on pool exhaustion and
    still completes everything, blocks are freed exactly once on eviction,
    and a drain signal landing mid-chunked-prefill stops at a chunk
-   boundary with the request reported unserved and its blocks returned.
+   boundary with the request reported unserved and its blocks returned;
+5. packed prefill — with ``prefill_batch > 1`` the scheduler streams up to
+   P pending requests' next chunks through ONE (P, bucket) dispatch per
+   round: token streams are BITWISE identical to sequential one-at-a-time
+   prefill (batch is a parallel GEMM dimension — per-row contraction
+   shapes are unchanged), a drain landing mid-packed-prefill frees every
+   pending row's blocks exactly once, and the lane's invariants are
+   enforced (engine/scheduler width agreement, paged-only, no spec mode).
 """
 
 import numpy as np
@@ -383,3 +390,118 @@ def test_paged_metrics_surface():
     assert m["prefill_chunks"] == 3            # 10 tokens / 4-token bucket
     assert m["kv_blocks_total"] == sched.allocator.capacity
     assert m["kv_block_utilization_peak"] > 0
+
+
+# ---------------------------------------------------------- 5. packed prefill
+@pytest.fixture(scope="module")
+def packed_engine(engines):
+    """Same params as the ``engines`` fixture's paged engine, but compiled
+    with the packed (P=2, bucket) prefill programs alongside the
+    sequential ladder."""
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+
+    cfg, _, params, _, _ = engines
+    return InferenceEngine(cfg, params, slots=2, max_len=32,
+                           prefill_buckets=(8, 16), kv_layout="paged",
+                           kv_block_size=8, prefill_batch=2)
+
+
+def _run_sched(engine, requests, prefill_batch=1):
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Scheduler
+
+    engine.reset()
+    sched = Scheduler(engine, prefill_batch=prefill_batch)
+    for r in requests:
+        sched.submit(r)
+    sched.run()
+    return sched, {c.request_id: c.tokens for c in sched.completed}
+
+
+def test_packed_prefill_streams_bitmatch_sequential(engines, packed_engine):
+    """Mixed greedy/sampled workload with multi-chunk prompts and a slot
+    turnover: the packed lane's token streams must be BITWISE identical
+    to sequential one-prompt-at-a-time prefill (same per-row chunk
+    shapes, same gather kernel), with the round/occupancy accounting the
+    metrics satellite added."""
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Request
+
+    cfg, _, _, paged, _ = engines
+    rng = np.random.default_rng(3)
+    reqs = [Request(id=f"r{i}",
+                    prompt=rng.integers(3, cfg.vocab_size, size=pl).tolist(),
+                    max_new_tokens=gen, temperature=t, top_p=0.9, seed=i)
+            for i, (pl, gen, t) in enumerate(
+                [(20, 6, 0.0), (9, 8, 0.8), (24, 5, 0.0), (11, 7, 0.7)])]
+    seq_sched, seq_out = _run_sched(paged, list(reqs))
+    pak_sched, pak_out = _run_sched(packed_engine, list(reqs),
+                                    prefill_batch=2)
+    assert pak_out == seq_out
+    assert len(pak_out) == 4
+    m = pak_sched.metrics()
+    # identical chunking discipline: the packed rows walked the same
+    # bucket sequence the sequential lane did
+    assert m["prefill_chunks"] == seq_sched.metrics()["prefill_chunks"]
+    assert m["prefill_packed_rounds"] > 0
+    assert m["prefill_packed_rows"] >= m["prefill_packed_rounds"]
+    assert 0.0 < m["prefill_packed_occupancy"] <= 1.0
+    # the fixture engines read through the gather kernel -> every chunk
+    # lands on the gather counter, none on the in-place one
+    assert m["prefill_gather_chunks"] == m["prefill_chunks"]
+    assert m["prefill_inplace_chunks"] == 0
+
+
+def test_drain_mid_packed_prefill_frees_all_rows(packed_engine):
+    """The drain signal lands between packed rounds while BOTH slots hold
+    half-prefilled rows: every pending row's blocks come back exactly
+    once, both requests are reported unserved, and the leak audit stays
+    clean."""
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+
+    packed_engine.reset()
+    fired = {"on": False}
+    sched = Scheduler(packed_engine, prefill_batch=2,
+                      stop_check=lambda: fired["on"])
+    for i in range(2):
+        sched.submit(Request(id=f"long{i}", prompt=[5 + i] * 24,
+                             max_new_tokens=4))
+    sched.step()                     # both admitted; round 1 of 2 runs
+    assert len(sched._pending_prefill) == 2
+    fired["on"] = True               # signal lands between rounds
+    while sched.pending():
+        sched.step()
+    assert not sched.admission_open
+    assert sorted(r.id for r in sched.unserved()) == ["long0", "long1"]
+    assert sched.completed == []
+    assert sched.allocator.free_count == sched.allocator.capacity
+    assert not sched.block_tables.any()
+    assert sched.audit_block_leaks(strict=True) == []
+
+
+def test_packed_lane_validates(engines, packed_engine):
+    """The lane's mutual exclusions, both layers: engine bounds P by slots
+    and requires pages; the scheduler refuses spec mode, engines without
+    the packed entry point, and width disagreement with the engine."""
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import Scheduler
+
+    cfg, _, params, _, _ = engines
+    with pytest.raises(ValueError, match="prefill_batch"):
+        InferenceEngine(cfg, params, slots=2, max_len=32,
+                        prefill_buckets=(8,), kv_layout="paged",
+                        kv_block_size=8, prefill_batch=3)
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(cfg, params, slots=2, max_len=32,
+                        prefill_buckets=(8, 16, 32), kv_layout="ring",
+                        prefill_batch=2)
+    fake = _FakePagedEngine(slots=4)
+    with pytest.raises(ValueError, match="prefill_packed"):
+        Scheduler(fake, prefill_batch=2)
+    fake_spec = _FakePagedEngine(slots=4)
+    fake_spec.spec_k = 2
+    with pytest.raises(ValueError, match="speculative"):
+        Scheduler(fake_spec, prefill_batch=2)
+    with pytest.raises(ValueError, match="prefill_batch"):
+        Scheduler(packed_engine, prefill_batch=3)  # engine compiled P=2

@@ -22,9 +22,12 @@ Evidence ladder for content-addressed prefix reuse over the paged pool:
    reference exactly once, the post-drain leak guard audits and raises,
    and the /metrics surface carries the ROADMAP-named series;
 6. streams — real compiled engines: cache-on streams (partial hits AND a
-   COW full-prompt repeat) are BIT-identical to cache-off streams, and
-   (slow) the speculative exact-verify path stays bit-identical to
-   non-speculative decoding with shared prefixes in play.
+   COW full-prompt repeat) are BIT-identical to cache-off streams, the
+   packed multi-request prefill lane reproduces the sequential lane's
+   streams bitwise over a pre-warmed tree (partial hits and a full-hit
+   COW repeat riding the same packed wave), and (slow) the speculative
+   exact-verify path stays bit-identical to non-speculative decoding
+   with shared prefixes in play.
 
 Module scope imports nothing from the package (collect-only guard in
 test_spec_decode.py).
@@ -498,6 +501,59 @@ def test_cached_streams_bitmatch_uncached(compiled_engine):
     assert on_out == off_out
     assert len(on_out) == 4
     eng.enable_prefix_cache = True              # restore for other tests
+
+
+def test_packed_prefill_streams_bitmatch_sequential_with_hits(compiled_engine):
+    """Packed admission allocates before any same-wave insert, so hits come
+    from a PRE-WARMED tree: warm one shared-prefix request to completion,
+    then serve a wave with two partial hits and a full-hit COW repeat
+    through the packed lane — streams must be BITWISE identical to the
+    sequential lane over the same warmed cache (hit-resumed rows enter the
+    packed program at their own start offsets, same chunk shapes)."""
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+
+    cfg, params, eng = compiled_engine
+    packed = InferenceEngine(cfg, params, slots=2, max_len=48,
+                             prefill_buckets=(16,), kv_layout="paged",
+                             kv_block_size=16, prefill_batch=2)
+    rng = np.random.default_rng(13)
+    shared = rng.integers(3, cfg.vocab_size, size=16).tolist()
+    tails = [rng.integers(3, cfg.vocab_size, size=n).tolist() for n in (5, 9)]
+    warm = Request(id="warm", prompt=shared + [4], max_new_tokens=2)
+    wave = [
+        Request(id="hit-a", prompt=shared + tails[0], max_new_tokens=6),
+        Request(id="hit-b", prompt=shared + tails[1], max_new_tokens=6,
+                temperature=0.8, top_p=0.9, seed=5),
+        Request(id="repeat", prompt=list(shared), max_new_tokens=6),
+    ]
+
+    def run(engine, pb):
+        engine.enable_prefix_cache = True
+        engine.reset()
+        sched = Scheduler(engine, eos_token_id=None, prefill_batch=pb)
+        sched.submit(warm)
+        sched.run()                            # seeds the tree, completes
+        for r in wave:
+            sched.submit(r)
+        sched.run()
+        return sched, {c.request_id: c.tokens for c in sched.completed}
+
+    seq_sched, seq_out = run(eng, 1)
+    pak_sched, pak_out = run(packed, 2)
+    assert pak_out == seq_out
+    assert len(pak_out) == 4
+    ms, mp = seq_sched.metrics(), pak_sched.metrics()
+    assert mp["prefill_packed_rounds"] > 0
+    assert mp["prefill_chunks"] == ms["prefill_chunks"]   # same chunking
+    assert mp["prefix_hits"] == ms["prefix_hits"] >= 3
+    assert mp["prefix_cow_copies"] >= 1        # the full-prompt repeat
+    assert (pak_sched.allocator.used_count
+            == pak_sched.prefix_cache.cached_blocks)
+    pak_sched.prefix_cache.flush()
+    assert pak_sched.allocator.free_count == pak_sched.allocator.capacity
 
 
 @pytest.mark.slow
