@@ -55,6 +55,28 @@ pass instead of k+1 decode dispatches —
   use distribution-preserving rejection sampling against the same
   per-slot temperature/top-p/top-k.
 
+**Tree speculative decoding** (``spec_tree``, on top of spec mode) widens
+each round from a k-chain to a branching token TREE at the same verify
+cost: the draft proposes a top-k fan-out at every depth of its chain (the
+siblings are free — they are top-k reads of distributions the chain
+already computed), the flattened tree is scored in ONE ancestor-masked
+verify forward (``models/llama.py tree_verify_with_cache`` over
+``ops/attention.py paged_tree_attention``), and the accept walk
+(sampler.py ``tree_accept``) takes the longest accepted PATH — so a round
+whose primary proposal is rejected can still commit a sibling instead of
+falling back to plain decode. The winning path's KV is committed by a
+device-side remap inside the slot's own blocks (kv_cache.py
+``remap_paged_path``); rejected branches rot as stale bytes past the
+committed length, exactly the linear rejected-suffix story — no allocator
+traffic per round. Tree shapes (``TreeShape``/``parse_spec_tree``,
+serve.py ``--spec-tree``) compile into a (draft, verify) program ladder
+keyed by fan-out tuple (:meth:`InferenceEngine._tree_pair`), so an
+adaptive controller can shrink the tree with live acceptance. Under
+``spec_verify_impl="exact"`` a tree round scores only its PRIMARY chain
+through the k+1 chained S=1 micro-steps — the PR-4 escape hatch that
+keeps greedy tree-spec streams bit-identical to non-speculative decode —
+while ``"chunk"`` is the full multi-branch forward.
+
 Checkpoints restore through the existing cross-topology
 ``checkpoint/manager.py`` path (:meth:`InferenceEngine.from_checkpoint`):
 the abstract TrainState is rebuilt exactly as the trainer builds it, params
@@ -95,6 +117,7 @@ from .kv_cache import (
     copy_kv_block,
     init_cache,
     init_paged_cache,
+    remap_paged_path,
 )
 from .sampler import (
     draft_key,
@@ -103,6 +126,8 @@ from .sampler import (
     sample_token_with_probs,
     slot_key,
     spec_accept,
+    tree_accept,
+    tree_key,
     verify_key,
 )
 
@@ -129,6 +154,108 @@ def _abstract(tree):
         tree)
 
 
+class TreeShape:
+    """STATIC structure of one speculative token tree.
+
+    ``fanouts`` (f_1 .. f_depth, each >= 1) gives the branch width at each
+    proposal depth: level l's f_l nodes are the draft's top-f_l candidates
+    after the PRIMARY (first) node of level l-1, so the tree is the draft's
+    one k-chain plus sibling fan-outs hanging off it — the chain costs the
+    draft exactly what linear speculation costs, and the siblings are free
+    top-k reads of distributions the chain already computed. ``(1,) * k``
+    is therefore the linear k-chain itself.
+
+    Flattened layout (what every consumer indexes by): row 0 is the root
+    (the committed last token), rows ``level_start[l] ..
+    level_start[l] + f_l`` are level l+1's nodes in proposal order, primary
+    first. Node i's KV is written at cache position ``offset + i``; its
+    rope position is ``offset + depths[i]``. Derived arrays are numpy and
+    baked into the compiled programs as constants:
+
+    - ``parents`` (S,): row index of each node's parent, -1 for the root.
+    - ``depths`` (S,): proposal depth, root 0.
+    - ``child_matrix`` (S, C): row i's children padded with -1 — the
+      accept walk's transition table (sampler.py ``tree_accept``).
+    - ``anc_mask`` (S, S) bool: ``anc_mask[r, j]`` iff j is on r's root
+      path (ancestors, self, root) — the verify attention rule
+      (ops/attention.py ``paged_tree_attention``).
+    - ``primary_rows`` (depth,): the primary chain's row per level — what
+      the ``exact`` verify mode scores.
+    """
+
+    def __init__(self, fanouts: Sequence[int]):
+        fanouts = tuple(int(f) for f in fanouts)
+        if not fanouts or any(f < 1 for f in fanouts):
+            raise ValueError(f"tree fan-outs must be >= 1 per level, got "
+                             f"{fanouts}")
+        self.fanouts = fanouts
+        self.depth = len(fanouts)
+        self.size = 1 + sum(fanouts)                 # S rows incl. root
+        self.c_max = max(fanouts)
+        starts, s0 = [], 1
+        for f in fanouts:
+            starts.append(s0)
+            s0 += f
+        self.level_start = tuple(starts)
+        self.primary_rows = tuple(starts)
+        parents = np.full((self.size,), -1, np.int32)
+        depths = np.zeros((self.size,), np.int32)
+        child = np.full((self.size, self.c_max), -1, np.int32)
+        prev_primary = 0
+        for lvl, f in enumerate(fanouts):
+            s0 = starts[lvl]
+            for j in range(f):
+                parents[s0 + j] = prev_primary
+                depths[s0 + j] = lvl + 1
+                child[prev_primary, j] = s0 + j
+            prev_primary = s0
+        self.parents, self.depths, self.child_matrix = parents, depths, child
+        anc = np.zeros((self.size, self.size), bool)
+        for r in range(self.size):
+            anc[r, 0] = True
+            a = r
+            while a >= 0:
+                anc[r, a] = True
+                a = int(parents[a])
+        self.anc_mask = anc
+
+    def shrink_to(self, budget: int) -> "TreeShape":
+        """The largest sub-shape spending at most ``budget`` draft tokens
+        (``sum(fanouts)``): trailing fan-outs shed width first, then whole
+        levels — so an adaptive controller walking its k ladder down maps
+        each rung to a deterministic smaller tree, and budget 1 is always
+        the linear single-proposal round."""
+        budget = max(1, int(budget))
+        f = list(self.fanouts)
+        while sum(f) > budget:
+            for i in range(len(f) - 1, -1, -1):
+                if f[i] > 1:
+                    f[i] -= 1
+                    break
+            else:
+                f.pop()
+        f = tuple(f)
+        return self if f == self.fanouts else TreeShape(f)
+
+    def __repr__(self):
+        return f"TreeShape({','.join(str(f) for f in self.fanouts)})"
+
+
+def parse_spec_tree(spec) -> TreeShape:
+    """``--spec-tree`` value into a :class:`TreeShape`: a ``"2,2,1"``-style
+    comma list of per-depth fan-outs, a sequence of ints, or an already
+    built shape (passed through)."""
+    if isinstance(spec, TreeShape):
+        return spec
+    if isinstance(spec, str):
+        try:
+            spec = [int(p) for p in spec.replace(" ", "").split(",") if p]
+        except ValueError:
+            raise ValueError(f"bad --spec-tree {spec!r}: want a comma list "
+                             f"of per-depth fan-outs, e.g. '2,2,1'")
+    return TreeShape(spec)
+
+
 class InferenceEngine:
     """Slot-granular prefill/decode over a trained ``Transformer``.
 
@@ -148,6 +275,7 @@ class InferenceEngine:
                  draft_params=None, spec_k: int = 0,
                  draft_num_blocks: Optional[int] = None,
                  spec_verify_impl: str = "exact",
+                 spec_tree=None,
                  prefix_cache: bool = True,
                  paged_kernel: str = "gather",
                  prefill_batch: int = 1):
@@ -259,6 +387,26 @@ class InferenceEngine:
             self.draft_model = Transformer(draft_cfg)
         elif draft_cfg is not None or draft_params is not None:
             raise ValueError("draft model given but spec_k == 0")
+
+        # --- tree speculative decoding: branching rounds -------------------
+        self.spec_tree: Optional[TreeShape] = None
+        if spec_tree is not None:
+            if not self.spec_k:
+                raise ValueError("spec_tree requires speculative decoding "
+                                 "(spec_k > 0 with a draft model): the tree "
+                                 "is a widening of the spec round, not a "
+                                 "third lifecycle")
+            shape = parse_spec_tree(spec_tree)
+            if shape.size >= self.max_len:
+                raise ValueError(f"tree shape {shape} has {shape.size} rows "
+                                 f">= max_len {self.max_len}: the verify "
+                                 f"window must fit a slot")
+            self.spec_tree = shape
+            # refeed width: the max tokens one round can emit (depth
+            # accepted + bonus). Fixed across the shrink ladder so every
+            # rung's draft program shares one refeed layout, and doubles
+            # as the draft-key stream stride (rungs never alias).
+            self._tree_refeed = shape.depth + 1
 
         with use_mesh(mesh):
             shardings = param_shardings(params, mesh)
@@ -634,6 +782,148 @@ class InferenceEngine:
         lengths = jnp.where(active, offsets + acc + 1, cache.lengths)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), out, acc
 
+    def _tree_draft_fn(self, shape, params, cache, block_tables, refeed,
+                       refeed_len, offsets, active, temperature, top_p,
+                       seeds, rounds):
+        """Propose one token TREE per slot in ONE compiled program.
+
+        The draft runs its ordinary linear chain — one refeed chunk plus
+        depth-1 chained S=1 micro-steps — and the tree's branches fall out
+        for free: at each level the PRIMARY child is the chain's own
+        sample/argmax (drawn from the post-filter distribution q_l, which
+        becomes its accept-test q row), and the f_l - 1 SIBLINGS are the
+        top logits excluding it. A sibling is a deterministic pick, so its
+        honest proposal law is the point mass at its token — its q row is
+        the exact one-hot, under which ``tree_accept``'s test
+        ``u * q(t) < p(t)`` reduces to accept-with-probability-p(t) and
+        the residual fold to removing t from p: a valid rejection step
+        that only ADDS acceptance chances on top of the primary chain.
+
+        The REFEED chunk replaces linear spec's first micro-step + d_k
+        back-fill: ``refeed`` (B, R) holds the tokens the PREVIOUS round
+        emitted (count ``refeed_len``, bonus token last), written at
+        positions ``offsets - refeed_len + 1 .. offsets``. A tree round
+        can commit tokens the draft chain never fed (an accepted sibling),
+        so the draft cache's last window is re-derived from the committed
+        truth every round — which also covers the fresh bonus token, hence
+        no separate back-fill. Invariant: before the chunk the draft KV is
+        correct up to ``offsets - refeed_len``; after it, up to
+        ``offsets``; the micro-steps then write the primary chain at
+        ``offsets + 1 ..`` (stale beyond the commit, overwritten by the
+        next refeed). R and the draft-key stride are the BASE shape's
+        ``depth + 1`` whatever rung is running, so ladder rungs share one
+        refeed layout and never alias a key.
+
+        Returns (cache, tree_tokens (B, S) — row 0 the root token — and
+        draft_probs (B, S, V) — row 0 zeros, primary rows q_l, sibling
+        rows one-hots)."""
+        b = self.slots
+        v = self.draft_cfg.vocab_size
+        s = shape.size
+        r_w = refeed.shape[1]
+        base = offsets - refeed_len + 1
+        valid = ((jnp.arange(r_w, dtype=jnp.int32)[None, :]
+                  < refeed_len[:, None]) & active[:, None])
+        logits, (ck, cv) = self.draft_model.apply(
+            {"params": params}, refeed, cache.k, cache.v, base,
+            block_tables=block_tables, write_valid=valid,
+            method="forward_with_cache")
+        last = jnp.take_along_axis(
+            logits, (refeed_len - 1)[:, None, None], axis=1
+        )[:, 0].astype(jnp.float32)
+        t_last = jnp.take_along_axis(refeed, (refeed_len - 1)[:, None],
+                                     axis=1)[:, 0]
+        tree_toks = jnp.zeros((b, s), jnp.int32).at[:, 0].set(t_last)
+        probs = jnp.zeros((b, s, v), jnp.float32)
+        for lvl, f in enumerate(shape.fanouts):      # static unroll
+            keys = jax.vmap(draft_key)(
+                seeds, rounds * self._tree_refeed + lvl)
+            nxt, p = jax.vmap(sample_token_with_probs,
+                              in_axes=(0, 0, 0, 0, None))(
+                last, keys, temperature, top_p, self.top_k)
+            s0 = shape.level_start[lvl]
+            tree_toks = tree_toks.at[:, s0].set(nxt)
+            probs = probs.at[:, s0, :].set(p)
+            if f > 1:
+                masked = last.at[jnp.arange(b), nxt].set(-jnp.inf)
+                _, sib = jax.lax.top_k(masked, f - 1)
+                sib = sib.astype(jnp.int32)
+                tree_toks = tree_toks.at[:, s0 + 1:s0 + f].set(sib)
+                probs = probs.at[:, s0 + 1:s0 + f, :].set(
+                    jax.nn.one_hot(sib, v, dtype=jnp.float32))
+            if lvl < shape.depth - 1:
+                step, (ck, cv) = self.draft_model.apply(
+                    {"params": params}, nxt[:, None], ck, cv,
+                    offsets + lvl + 1, block_tables=block_tables,
+                    write_valid=active[:, None],
+                    method="forward_with_cache")
+                last = step[:, 0].astype(jnp.float32)
+        lengths = jnp.where(active, offsets + shape.depth, cache.lengths)
+        return (PagedKVCache(k=ck, v=cv, lengths=lengths), tree_toks,
+                probs)
+
+    def _tree_verify_fn(self, shape, params, cache, block_tables,
+                        tree_tokens, draft_probs, offsets, active,
+                        temperature, top_p, seeds, rounds):
+        """Score one flattened token tree per slot and commit the winning
+        path, in ONE compiled program.
+
+        ``"chunk"`` mode is the real tree: a single (B, S) ancestor-masked
+        forward (``tree_verify_with_cache`` — node KV at ``offsets + row``,
+        rope at ``offsets + depth(row)``) scores every branch at once, the
+        vmapped accept walk (sampler.py ``tree_accept``) picks the longest
+        accepted path under ``tree_key``, and the epilogue REMAPS the
+        winners' KV rows from tree-window to committed positions inside the
+        slot's own blocks (kv_cache.py ``remap_paged_path``) — losers rot
+        as stale bytes past the committed length, so a round still costs
+        zero allocator traffic.
+
+        ``"exact"`` mode scores only the PRIMARY chain through the linear
+        k+1 chained S=1 micro-steps (:meth:`_verify_fn`, which also does
+        the accept under ``verify_key``): the chain's rows land at their
+        committed positions directly, so no remap — and the op shapes
+        being the decode program's keeps greedy tree-spec streams
+        bit-identical to non-speculative decode, the escape hatch the
+        multi-branch chunk forward (shape-dependent bf16 accumulation)
+        cannot offer. Siblings are proposed but never scored there.
+
+        Returns (cache, out (B, depth+1), accepted (B,), path (B, depth))
+        — ``path`` is the accepted nodes' tree rows, what the scheduler's
+        branch-utilization gauge reads."""
+        b = self.slots
+        depth = shape.depth
+        if self.spec_verify_impl == "chunk":
+            tpos = (offsets[:, None]
+                    + jnp.asarray(shape.depths, jnp.int32)[None, :])
+            anc = jnp.asarray(shape.anc_mask)
+            cm = jnp.asarray(shape.child_matrix, jnp.int32)
+            valid = jnp.broadcast_to(active[:, None], tree_tokens.shape)
+            logits, (nk, nv) = self.model.apply(
+                {"params": params}, tree_tokens, cache.k, cache.v, offsets,
+                block_tables=block_tables, tree_positions=tpos,
+                anc_mask=anc, write_valid=valid,
+                method="tree_verify_with_cache")
+            logits = logits.astype(jnp.float32)
+            keys = jax.vmap(tree_key)(seeds, rounds)
+            out, path, acc = jax.vmap(
+                lambda tt, dp, tl, ky, te, tp_: tree_accept(
+                    tt, dp, tl, ky, te, tp_, cm, depth, self.top_k))(
+                tree_tokens, draft_probs, logits, keys, temperature, top_p)
+            nk = tuple(remap_paged_path(p, block_tables, offsets, path, acc)
+                       for p in nk)
+            nv = tuple(remap_paged_path(p, block_tables, offsets, path, acc)
+                       for p in nv)
+            lengths = jnp.where(active, offsets + acc + 1, cache.lengths)
+            return PagedKVCache(k=nk, v=nv, lengths=lengths), out, acc, path
+        prim = list(shape.primary_rows)
+        new_cache, out, acc = self._verify_fn(
+            depth, params, cache, block_tables, tree_tokens[:, 0],
+            tree_tokens[:, prim], draft_probs[:, prim], offsets, active,
+            temperature, top_p, seeds, rounds)
+        path = jnp.broadcast_to(
+            jnp.asarray(prim, jnp.int32)[None, :], (b, depth))
+        return new_cache, out, acc, path
+
     def _build_programs(self):
         p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
         scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
@@ -688,6 +978,10 @@ class InferenceEngine:
                 dc_abs = _abstract(self.draft_cache)
                 self._spec_programs = {}
                 self._draft_k, self._verify = self._spec_pair(self.spec_k)
+                if self.spec_tree is not None:
+                    self._tree_programs = {}
+                    self._tree_draft, self._tree_verify = self._tree_pair(
+                        self.spec_tree)
                 self._draft_prefill = {}
                 for b in self.prefill_buckets:
                     tok_abs = jax.ShapeDtypeStruct((1, b), jnp.int32)
@@ -738,6 +1032,57 @@ class InferenceEngine:
             p_abs, c_abs, tables_abs, slots_i, dtoks_abs, dprobs_abs,
             slots_i, slots_b, slots_f, slots_f, slots_i, slots_i).compile()
         return draft, verify
+
+    def _compile_tree_pair(self, shape: TreeShape):
+        """AOT-compile one (tree-draft, tree-verify) program pair for
+        ``shape``. The shape is bound with functools.partial — its derived
+        arrays (depths, ancestor mask, child matrix) bake into the
+        programs as constants; the refeed width stays the BASE shape's so
+        every rung shares one host-side refeed layout."""
+        p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
+        dp_abs = _abstract(self.draft_params)
+        dc_abs = _abstract(self.draft_cache)
+        slots_i = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
+        slots_f = jax.ShapeDtypeStruct((self.slots,), jnp.float32)
+        slots_b = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
+        tables_abs = jax.ShapeDtypeStruct(
+            (self.slots, self.max_blocks_per_slot), jnp.int32)
+        refeed_abs = jax.ShapeDtypeStruct(
+            (self.slots, self._tree_refeed), jnp.int32)
+        ttoks_abs = jax.ShapeDtypeStruct((self.slots, shape.size), jnp.int32)
+        tprobs_abs = jax.ShapeDtypeStruct(
+            (self.slots, shape.size, self.cfg.vocab_size), jnp.float32)
+        draft = jax.jit(
+            functools.partial(self._tree_draft_fn, shape),
+            donate_argnums=(1,)).lower(
+            dp_abs, dc_abs, tables_abs, refeed_abs, slots_i, slots_i,
+            slots_b, slots_f, slots_f, slots_i, slots_i).compile()
+        verify = jax.jit(
+            functools.partial(self._tree_verify_fn, shape),
+            donate_argnums=(1,)).lower(
+            p_abs, c_abs, tables_abs, ttoks_abs, tprobs_abs, slots_i,
+            slots_b, slots_f, slots_f, slots_i, slots_i).compile()
+        return draft, verify
+
+    def _tree_pair(self, shape: TreeShape):
+        """The compiled (tree-draft, tree-verify) pair for ``shape``,
+        compiling on first use — the tree sibling of :meth:`_spec_pair`.
+        Only shrinkages of the configured base shape are legal (the
+        adaptive ladder walks ``TreeShape.shrink_to``), so the ladder is
+        finitely bounded and every rung fits the base refeed layout."""
+        if self.spec_tree is None:
+            raise ValueError("engine built without a tree shape "
+                             "(spec_tree unset)")
+        shape = parse_spec_tree(shape)
+        if (shape.depth > self.spec_tree.depth
+                or shape.size > self.spec_tree.size):
+            raise ValueError(f"tree rung {shape} exceeds the configured "
+                             f"base shape {self.spec_tree}")
+        pair = self._tree_programs.get(shape.fanouts)
+        if pair is None:
+            pair = self._compile_tree_pair(shape)
+            self._tree_programs[shape.fanouts] = pair
+        return pair
 
     def _compile_burst(self, n: int):
         """AOT-compile the n-token burst decode program (``n`` bound with
@@ -887,7 +1232,8 @@ class InferenceEngine:
                 top_p: float = 1.0, seed: int = 0,
                 stop_check: Optional[Callable[[], bool]] = None,
                 on_chunk: Optional[Callable[[], None]] = None,
-                start_pos: int = 0) -> Optional[int]:
+                start_pos: int = 0,
+                draft_start_pos: int = 0) -> Optional[int]:
         """Prompt into ``slot``; returns the first generated token id.
 
         Ring layout: the prompt must fit the largest bucket (one shot).
@@ -915,8 +1261,14 @@ class InferenceEngine:
         BOTH pools and reports the request unserved. The draft phase's
         sampled token is discarded (the target's first token is the one
         emitted; the draft proposes only from round 1 on). The draft phase
-        always streams the FULL prompt regardless of ``start_pos``: the
-        draft pool opts out of prefix caching (scheduler docstring).
+        resumes at ``draft_start_pos`` under the same contract as the
+        target's ``start_pos``: the scheduler keeps a DRAFT-pool mirror of
+        the prefix cache fed the same insertions, so a shared system
+        prompt skips the draft prefill compute too, and because the shared
+        draft blocks hold the bytes a zero-offset draft prefill would have
+        written, a cache-hit spec stream's proposals — and therefore the
+        stream itself — are unchanged cache-on vs cache-off
+        (tests/test_spec_decode.py asserts it).
         """
         ids = np.asarray(token_ids, np.int32).reshape(-1)
         n = ids.size
@@ -958,9 +1310,23 @@ class InferenceEngine:
                 raise ValueError(
                     f"draft_block_row has {drow.shape[0]} entries, "
                     f"expected {self.max_blocks_per_slot}")
-            if self._stream_chunks(True, drow, ids, slot, temperature,
-                                   top_p, seed, stop_check,
-                                   on_chunk) is None:
+            if not 0 <= draft_start_pos <= n:
+                raise ValueError(f"draft_start_pos {draft_start_pos} "
+                                 f"outside [0, {n}]")
+            if draft_start_pos == n:
+                # Full-prompt draft hit. Unlike the target (which must
+                # re-derive the LAST position's logits to sample the first
+                # token, hence its COW resume at n-1), the draft phase
+                # samples nothing — its only job is committed KV for
+                # positions [0, n), and the shared blocks already hold it.
+                # Nothing to compute: just commit the fill count.
+                lengths = np.asarray(self.draft_cache.lengths).copy()
+                lengths[slot] = n
+                self.draft_cache = self.draft_cache.replace(
+                    lengths=jnp.asarray(lengths))
+            elif self._stream_chunks(True, drow, ids, slot, temperature,
+                                     top_p, seed, stop_check, on_chunk,
+                                     start_pos=draft_start_pos) is None:
                 return None
         return int(tok)
 
@@ -1146,6 +1512,106 @@ class InferenceEngine:
             self.params, self.cache, np.asarray(block_tables, np.int32),
             toks, d_toks, d_probs, lens, act, temp, tp, sd, rd)
         return np.asarray(out), np.asarray(acc)
+
+    def spec_tree_round(self, refeed, refeed_len, lengths, active,
+                        temperature, top_p, seeds, rounds,
+                        block_tables=None, draft_block_tables=None,
+                        shape=None):
+        """One TREE-speculative round over all slots: a branching draft
+        then one ancestor-masked verify — still two dispatches, but up to
+        ``depth + 1`` emitted tokens with extra acceptance chances at
+        every level (an accepted sibling where linear spec would have
+        rejected the whole suffix).
+
+        ``lengths`` is the committed-KV convention of :meth:`spec_round`;
+        ``refeed`` (slots, depth+1) / ``refeed_len`` carry the tokens the
+        PREVIOUS round emitted per slot (first round: just the prefill
+        token, len 1) — the draft rewrites their KV window before
+        proposing, because a committed sibling is a token its chain never
+        fed (``_tree_draft_fn`` documents the invariant). ``shape``
+        (default the configured ``spec_tree``) selects the rung from the
+        compiled ladder; an adaptive controller passes
+        ``engine.spec_tree.shrink_to(k)``.
+
+        Returns ``(out_tokens (slots, depth+1), accepted (slots,), path
+        (slots, depth))`` host arrays: slot s emitted ``accepted[s] + 1``
+        tokens; ``path[s, :accepted[s]]`` is the accepted nodes' tree rows
+        (primary chain under ``exact`` verify), which is how the scheduler
+        attributes acceptance to branches."""
+        if self.spec_tree is None:
+            raise ValueError("engine built without a tree shape "
+                             "(spec_tree unset)")
+        if block_tables is None or draft_block_tables is None:
+            raise ValueError("spec_tree_round requires both pools' block "
+                             "tables")
+        shape = self.spec_tree if shape is None else parse_spec_tree(shape)
+        draft_prog, verify_prog = self._tree_pair(shape)
+        rf = np.zeros((self.slots, self._tree_refeed), np.int32)
+        src = np.asarray(refeed, np.int32)
+        rf[:, :src.shape[1]] = src[:, :self._tree_refeed]
+        rl = np.clip(np.asarray(refeed_len, np.int32), 1, self._tree_refeed)
+        lens = np.asarray(lengths, np.int32)
+        act = np.asarray(active, bool)
+        temp = np.asarray(temperature, np.float32)
+        tp = np.asarray(top_p, np.float32)
+        sd = np.asarray(seeds, np.int32)
+        rd = np.asarray(rounds, np.int32)
+        self.draft_cache, t_toks, t_probs = draft_prog(
+            self.draft_params, self.draft_cache,
+            np.asarray(draft_block_tables, np.int32), rf, rl, lens, act,
+            temp, tp, sd, rd)
+        self.cache, out, acc, path = verify_prog(
+            self.params, self.cache, np.asarray(block_tables, np.int32),
+            t_toks, t_probs, lens, act, temp, tp, sd, rd)
+        return np.asarray(out), np.asarray(acc), np.asarray(path)
+
+    def fork_slot(self, src_slot: int, dst_slot: int, length: int,
+                  src_row, allocator):
+        """COW-fork slot ``src_slot``'s first ``length`` committed tokens
+        into ``dst_slot`` — the beam-search primitive over the paged
+        substrate. Full shared blocks are NOT copied: ``dst``'s table row
+        aliases them and the allocator refcount rises (``incref``), the
+        same sharing contract the prefix cache uses; only the partial
+        boundary block (``length % block_size != 0``) is duplicated
+        device-side (:meth:`cow_copy`) into a freshly allocated block, so
+        both beams can keep writing inside it without seeing each other.
+        Returns ``dst``'s block row (np.int32, padded with 0), or None if
+        the pool cannot supply the boundary block (caller's admission
+        problem — nothing was acquired). The caller owns both slots'
+        host bookkeeping and later frees each row through the uniform
+        allocator path (shared blocks drop a ref, the private boundary
+        block frees outright — tests/test_spec_decode.py pins the
+        contract, double-free raise included)."""
+        if self.kv_layout != "paged":
+            raise ValueError("fork_slot requires the paged KV layout")
+        if not (0 <= src_slot < self.slots and 0 <= dst_slot < self.slots
+                and src_slot != dst_slot):
+            raise ValueError("fork_slot: bad slot pair "
+                             f"({src_slot}, {dst_slot})")
+        if not 0 < length <= self.max_len:
+            raise ValueError(f"fork length {length} outside (0, "
+                             f"{self.max_len}]")
+        row = np.asarray(src_row, np.int32).reshape(-1)
+        if row.shape[0] != self.max_blocks_per_slot:
+            raise ValueError(f"src_row has {row.shape[0]} entries, "
+                             f"expected {self.max_blocks_per_slot}")
+        n_full, rem = divmod(length, self.block_size)
+        dst_row = np.zeros_like(row)
+        fresh = None
+        if rem:
+            fresh = allocator.alloc(1)
+            if fresh is None:
+                return None
+        for i in range(n_full):
+            allocator.incref([int(row[i])])
+            dst_row[i] = row[i]
+        if rem:
+            dst_row[n_full] = fresh[0]
+            self.cow_copy(int(row[n_full]), int(fresh[0]))
+        lengths = np.asarray(self.cache.lengths).copy()
+        lengths[dst_slot] = length
+        self.cache = self.cache.replace(lengths=jnp.asarray(lengths))
+        return dst_row
 
     def reset(self) -> None:
         """Zero all slot lengths (the buffers' stale contents are masked).

@@ -164,6 +164,44 @@ def write_paged_kv(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
     return pool.at[blk.reshape(-1), :, off.reshape(-1), :].set(upd)
 
 
+def remap_paged_path(pool: jax.Array, block_tables: jax.Array,
+                     start: jax.Array, src_nodes: jax.Array,
+                     accepted: jax.Array) -> jax.Array:
+    """Commit a tree-verify round's WINNING path: move each accepted
+    node's (kv_heads, head_dim) row from its tree-window position to its
+    committed position, inside the slot's own blocks.
+
+    A tree round writes node i's KV at position ``start[b] + i`` (row
+    order), but the accepted path's nodes p_0 < p_1 < ... are generally
+    non-contiguous rows; the committed stream needs them at
+    ``start[b] + 1 + j``. ``src_nodes`` (B, depth) holds the path's node
+    row indices, ``accepted`` (B,) how many are live. Moves with
+    ``j >= accepted[b]`` divert to null block 0 (same discipline as
+    :func:`write_paged_kv`), so rejected branches simply rot as stale
+    bytes past the new committed length — the linear-spec rejected-suffix
+    story, no allocator traffic. Primary-chain moves (src == dst) are
+    harmless bitwise no-ops: every source row is gathered before the one
+    scatter writes. This runs as the tree-verify program's epilogue
+    (inference/engine.py), one gather+scatter per layer per pool.
+    """
+    bs = pool.shape[2]
+    b, depth = src_nodes.shape
+    nb = block_tables.shape[1]
+    steps = jnp.arange(depth, dtype=jnp.int32)[None, :]
+    src_pos = start[:, None] + src_nodes                        # (B, depth)
+    dst_pos = start[:, None] + 1 + steps
+    live = steps < accepted[:, None]
+    src_blk = jnp.take_along_axis(
+        block_tables, jnp.clip(src_pos // bs, 0, nb - 1), axis=1)
+    dst_blk = jnp.where(live & (dst_pos // bs < nb),
+                        jnp.take_along_axis(
+                            block_tables, jnp.clip(dst_pos // bs, 0, nb - 1),
+                            axis=1), 0)
+    vals = pool[src_blk.reshape(-1), :, (src_pos % bs).reshape(-1), :]
+    return pool.at[dst_blk.reshape(-1), :,
+                   (dst_pos % bs).reshape(-1), :].set(vals)
+
+
 def copy_kv_block(pool: jax.Array, src: jax.Array, dst: jax.Array
                   ) -> jax.Array:
     """Copy one pool block's (kv_heads, block_size, head_dim) contents from

@@ -22,6 +22,10 @@ filtered distributions:
   acceptance test ``u * q(d) < p(d)`` degenerates to exact argmax matching
   and the resample to the target argmax, so the single kernel serves both
   modes and greedy outputs stay BIT-identical to the non-speculative path.
+- :func:`tree_accept` — the multi-branch generalization: one walk down a
+  flattened draft TREE, greedy longest-accepted-path selection or
+  SpecInfer-style recursive rejection per level, emitting the accepted
+  path plus one resampled/bonus token.
 
 :class:`AdaptiveK` is the one HOST-side piece here: the controller that
 tunes the round width k from live acceptance, colocated with the accept
@@ -110,6 +114,13 @@ def verify_key(seed: jnp.ndarray, round_: jnp.ndarray) -> jax.Array:
     """Accept/resample PRNG stream for one verify round, disjoint from both
     :func:`slot_key` and :func:`draft_key`."""
     return jax.random.fold_in(slot_key(seed, round_), 0x7E)
+
+
+def tree_key(seed: jnp.ndarray, round_: jnp.ndarray) -> jax.Array:
+    """Accept/resample PRNG stream for one TREE-verify round
+    (:func:`tree_accept`), disjoint from :func:`slot_key`,
+    :func:`draft_key` and :func:`verify_key` (fold constant 0x3B)."""
+    return jax.random.fold_in(slot_key(seed, round_), 0x3B)
 
 
 def sample_token_with_probs(logits: jnp.ndarray, key: jax.Array,
@@ -204,6 +215,109 @@ def spec_accept(draft_tokens: jnp.ndarray, draft_probs: jnp.ndarray,
         [draft_tokens, jnp.zeros((1,), jnp.int32)], axis=0)
     out = jnp.where(idx < a, d_pad, 0).at[a].set(bonus)
     return out, a
+
+
+def tree_accept(tree_tokens: jnp.ndarray, draft_probs: jnp.ndarray,
+                target_logits: jnp.ndarray, key: jax.Array,
+                temperature: jnp.ndarray, top_p: jnp.ndarray,
+                child_matrix: jnp.ndarray, depth: int, top_k: int = 0):
+    """Tree-speculative accept/resample for ONE slot (the engine vmaps it).
+
+    The round's token tree is flattened to S rows in topological order:
+    row 0 is the committed last token (the root — never itself accepted),
+    rows 1..S-1 are draft proposals. The STATIC structure arrives as
+    ``child_matrix`` (S, C) int32 — row i lists node i's children in
+    proposal order, padded with -1 — and ``depth`` (python int), the tree's
+    maximum proposal depth, which bounds the walk's unrolled length.
+
+    tree_tokens:   (S,) int32 — row 0 the committed token, rest proposals.
+    draft_probs:   (S, V) fp32 — q_i, the distribution node i's token was
+                   drawn from (row 0 unused).
+    target_logits: (S, V) fp32 — tree-verify logits; row i is the target's
+                   next-token law AFTER node i's token given node i's
+                   ancestor path (so row 0 scores the first proposal level
+                   and an accepted leaf's row is the bonus position).
+
+    Walk from the root, one tree level per step. Greedy slots take the
+    longest ACCEPTED path: a child is accepted iff its token equals the
+    target argmax at the current node, so the walk is exact argmax matching
+    level by level and stays bit-identical to non-speculative decode.
+    Sampled slots run SpecInfer-style recursive rejection (Miao et al.
+    2023): children are tried in order with ``u * q_c(t_c) < p(t_c)``
+    against the current residual p (initialized to the filtered target
+    distribution at the node); each rejection folds that child out,
+    ``p <- norm(max(p - q_c, 0))``, and if every child is rejected one
+    token is emitted from the final residual — so the emitted path is
+    distributed EXACTLY as sequential target samples, branches only adding
+    acceptance chances. On full acceptance to ``depth`` the extra token is
+    the bonus sample from the leaf's target distribution. Both modes share
+    one walk; greedy is selected with ``where`` and never consumes noise.
+
+    Returns ``(out_tokens, path_nodes, accepted)``: out_tokens (depth+1,)
+    int32 — the a = accepted proposal tokens then the resampled/bonus
+    token at index a (tail zeros); path_nodes (depth,) int32 — the
+    accepted nodes' ROW indices in walk order (tail zeros), which is what
+    the KV commit remap consumes.
+    """
+    s, v = draft_probs.shape
+    c_max = child_matrix.shape[1]
+    greedy_toks = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+    p_rows = _filtered_probs(target_logits, temperature, top_p, top_k)
+    cur = jnp.int32(0)
+    alive = jnp.bool_(True)
+    resid = p_rows[0]          # sampled-mode residual at the current node
+    stop_resid = p_rows[0]     # residual captured where the walk died
+    a = jnp.int32(0)
+    path = jnp.zeros((depth,), jnp.int32)
+    out = jnp.zeros((depth + 1,), jnp.int32)
+    for lvl in range(depth):
+        kids = jnp.take(child_matrix, cur, axis=0)              # (C,)
+        kid_ok = kids >= 0
+        safe_kids = jnp.maximum(kids, 0)
+        kid_tok = jnp.take(tree_tokens, safe_kids)              # (C,)
+        # greedy: first child proposing the target argmax at cur
+        g = jnp.take(greedy_toks, cur)
+        g_match = kid_ok & (kid_tok == g)
+        g_has = jnp.any(g_match)
+        g_next = jnp.take(safe_kids, jnp.argmax(g_match))
+        # sampled: recursive rejection across the children, in order
+        p_lvl = resid
+        s_has = jnp.bool_(False)
+        s_next = jnp.int32(0)
+        for c in range(c_max):
+            ok = kid_ok[c] & ~s_has
+            t_c = kid_tok[c]
+            q_c = jnp.take(draft_probs, safe_kids[c], axis=0)   # (V,)
+            u = jax.random.uniform(
+                jax.random.fold_in(key, lvl * c_max + c), ())
+            acc_c = ok & (u * q_c[t_c] < p_lvl[t_c])
+            s_next = jnp.where(acc_c, safe_kids[c], s_next)
+            s_has = s_has | acc_c
+            new_p = jnp.maximum(p_lvl - q_c, 0.0)
+            tot = new_p.sum()
+            new_p = jnp.where(tot > 0.0, new_p / tot, p_lvl)
+            p_lvl = jnp.where(ok & ~acc_c, new_p, p_lvl)
+        samp = temperature > 0.0
+        acc = alive & jnp.where(samp, s_has, g_has)
+        nxt = jnp.where(samp, s_next, g_next)
+        path = path.at[lvl].set(jnp.where(acc, nxt, path[lvl]))
+        out = out.at[lvl].set(
+            jnp.where(acc, jnp.take(tree_tokens, nxt), out[lvl]))
+        a = a + acc.astype(jnp.int32)
+        stop_resid = jnp.where(alive & ~acc, p_lvl, stop_resid)
+        cur = jnp.where(acc, nxt, cur)
+        resid = jnp.where(acc, jnp.take(p_rows, nxt, axis=0), resid)
+        alive = acc
+    # survivor's bonus comes from the leaf's full distribution; a dead
+    # walk emits from the residual at the level it died
+    final_resid = jnp.where(alive, resid, stop_resid)
+    resampled = jax.random.categorical(
+        jax.random.fold_in(key, depth * c_max + 1),
+        jnp.log(jnp.maximum(final_resid, 1e-38))).astype(jnp.int32)
+    extra = jnp.where(temperature > 0.0, resampled,
+                      jnp.take(greedy_toks, cur))
+    out = out.at[a].set(extra)
+    return out, path, a
 
 
 class AdaptiveK:

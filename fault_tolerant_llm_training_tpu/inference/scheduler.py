@@ -28,13 +28,21 @@ COPY-ON-WRITE duplicated (engine.cow_copy) into a private block before
 prefill resumes inside it; shared blocks are never written. Under pool
 pressure, admission evicts LRU cached prefixes no live slot references
 before making the head of the queue wait. The DRAFT pool (speculative
-mode) opts OUT of prefix caching by design: draft prefill is a tiny
-fraction of target prefill (that is what makes the draft a draft), while
-participating would cost a second radix tree, a second COW program family,
-and draft-pool admission coupling — all to skip compute the bench can't
-see. Draft admission stays full-footprint; decode/spec rounds only ever
-write at positions >= prompt_len, which live in the slot's private blocks,
-so sharing never constrains them.
+mode) runs a MIRROR of the same scheme: a second radix tree over the
+draft allocator, fed the same insertions at the same block boundaries, so
+a shared system prompt skips the draft prefill compute too — with tree
+speculation refeeding the draft every round, draft prefill is no longer a
+negligible fraction of admission cost. The mirror is strictly cheaper
+than the target's cache in one way: a FULL-prompt draft hit needs no
+copy-on-write resume at all (the draft phase samples nothing — covering
+every prompt position means there is nothing left to compute), so the
+draft phase is skipped outright. Admission still gates on the COMBINED
+footprint, and a shortage on either side rolls back BOTH pools' acquired
+references; decode/spec rounds only ever write at positions >=
+prompt_len, which live in the slot's private blocks, so sharing never
+constrains them. Cache-hit spec streams are bit-identical to cache-off
+(shared draft blocks hold the bytes a zero-offset draft prefill would
+have written — tests/test_spec_decode.py asserts it).
 
 With ``prefill_batch > 1`` (engine built to match) admission switches to
 the PACKED prefill lane: allocation keeps the exact sequential front-half
@@ -56,6 +64,20 @@ by the COMBINED draft+target footprint (both pools must cover the
 request, or it waits at the head of the queue), and eviction/drain frees
 both pools together. Acceptance statistics are exported per round
 (``ftl_spec_*`` metrics) and per request (Completion spec fields).
+
+With a TREE shape on top (``engine.spec_tree``) every speculative round
+is a tree round (engine.py ``spec_tree_round``): the scheduler feeds the
+round the tokens the PREVIOUS round banked for the slot (the refeed
+window — a committed sibling is a token the draft chain never fed), picks
+the round's shape from the adaptive controller's budget via
+``TreeShape.shrink_to`` when one is installed, and attributes acceptance
+per node row — ``spec_tree_nodes_total``, the ``spec_accepted_path_len``
+histogram and the branch-utilization gauge (accepted tokens taken OFF the
+primary chain) come from the returned path. Banking keeps the linear
+rounds' truncation contract, so EOS/budget eviction and the drain
+lifecycle are unchanged; a mid-stream drain frees branch scratch with the
+slot's ordinary allocation (tree rows live inside it), leaving the leak
+guard clean.
 
 The scheduler is also the drain point for the fault-tolerant serving
 lifecycle: ``stop_admission()`` (serve.py calls it when a SIGUSR1/SIGTERM
@@ -228,6 +250,10 @@ class _Slot:
         self.steps = 1  # decode-step counter; prefill consumed step 0
         self.submitted_at = submitted_at
         self.first_token_at = now
+        # tree-spec refeed window: the tokens banked by the LAST round
+        # (prefill counts as round 0 with just the first token) — the
+        # next tree round rewrites their draft KV before proposing
+        self.emitted = [first_token]
         # spec-mode per-request accounting (see Completion)
         self.spec_proposed = 0
         self.spec_accepted = 0
@@ -352,6 +378,16 @@ class Scheduler:
             self.spec_rounds = 0
             self.spec_draft_tokens = 0
             self.spec_accepted_tokens = 0
+        # Tree speculation (engine.spec_tree): every spec round becomes a
+        # tree round; acceptance is attributed per node row (module
+        # docstring) so branch utilization is observable.
+        self.spec_tree = (getattr(engine, "spec_tree", None)
+                          if self.spec_k else None)
+        if self.spec_tree is not None:
+            self.spec_tree_rounds = 0
+            self.spec_tree_nodes = 0
+            self.spec_tree_accepted = 0
+            self.spec_tree_off_primary = 0
         # /metrics surface (obs/registry.py): serve.py --metrics-port scrapes
         # these live while the batching loop runs.
         r = registry or default_registry()
@@ -413,6 +449,19 @@ class Scheduler:
             "Tokens banked per verify round (accepted prefix + bonus, "
             "after EOS/budget truncation)",
             buckets=SPEC_TOKEN_BUCKETS)
+        self._m_tree_nodes = r.counter(
+            "spec_tree_nodes_total",
+            "Tree nodes scored by tree-verify dispatches (root included; "
+            "active slots x shape size per round)")
+        self._m_tree_path_len = r.histogram(
+            "spec_accepted_path_len",
+            "Accepted path length per slot per tree-verify round "
+            "(0..depth, before EOS/budget truncation)",
+            buckets=SPEC_TOKEN_BUCKETS)
+        self._m_tree_branch_util = r.gauge(
+            "spec_tree_branch_utilization",
+            "Accepted tokens taken OFF the primary draft chain / accepted "
+            "tokens (0-1, running; 0 under the exact verify mode)")
         self._m_dispatches = r.counter(
             "decode_dispatches_total",
             "Device programs launched for decode (burst counts 1 per "
@@ -449,6 +498,15 @@ class Scheduler:
                 and getattr(engine, "enable_prefix_cache", False)):
             self.prefix_cache = PrefixCache(
                 self.allocator, engine.block_size,
+                evictions_counter=self._m_prefix_evictions)
+        # DRAFT-pool mirror (module docstring): same radix scheme over the
+        # draft allocator, fed the same insertions, so shared prompts skip
+        # draft prefill too. Full-prompt draft hits skip the phase outright
+        # (no COW — the draft samples nothing at prefill).
+        self.draft_prefix_cache: Optional[PrefixCache] = None
+        if self.spec_k and self.prefix_cache is not None:
+            self.draft_prefix_cache = PrefixCache(
+                self.draft_allocator, engine.block_size,
                 evictions_counter=self._m_prefix_evictions)
         if self.kv_layout == "paged":
             self._m_blocks_free.set(self.allocator.free_count)
@@ -549,7 +607,7 @@ class Scheduler:
         while free and self.queue:
             req, submitted_at = self.queue[0]
             blocks, dblocks = None, None
-            hit = None
+            hit, dhit = None, None
             if self.kv_layout == "paged":
                 # admission is by free-BLOCK count, not free-slot count:
                 # the head of the queue waits (FIFO, no starvation) until
@@ -584,10 +642,26 @@ class Scheduler:
                         self.allocator.free(hit.blocks)
                     break
                 if self.spec_k:
-                    # draft pool opts OUT of prefix caching (module
-                    # docstring): full footprint, rollback on shortage
-                    dblocks = self.draft_allocator.alloc(total)
+                    # DRAFT-pool mirror of the same protocol. A full draft
+                    # hit takes NO extra COW block: the draft phase is
+                    # skipped outright (module docstring). A shortage here
+                    # rolls back every reference both pools acquired.
+                    if self.draft_prefix_cache is not None:
+                        dhit = self.draft_prefix_cache.match(req.prompt)
+                        if not dhit.blocks:
+                            dhit = None
+                    dfresh = total - (len(dhit.blocks) if dhit else 0)
+                    if dhit is not None:
+                        self.draft_prefix_cache.acquire(dhit)
+                    dblocks = self.draft_allocator.alloc(dfresh)
+                    if (dblocks is None
+                            and self.draft_prefix_cache is not None):
+                        if self.draft_prefix_cache.evict(
+                                dfresh - self.draft_allocator.free_count):
+                            dblocks = self.draft_allocator.alloc(dfresh)
                     if dblocks is None:
+                        if dhit is not None:
+                            self.draft_allocator.free(dhit.blocks)
                         self.allocator.free(blocks)
                         if hit is not None:
                             self.allocator.free(hit.blocks)
@@ -633,14 +707,26 @@ class Scheduler:
                         pos=start_pos))
                     continue
                 spec_kw = {}
+                slot_dblocks = dblocks
                 if self.spec_k:
+                    draft_start = 0
+                    if dhit is not None:
+                        # mirror of the target's hit splice, minus the
+                        # full-hit COW: the shared blocks lead the row, the
+                        # fresh tail covers the divergent prompt remainder
+                        # and the generation budget; a full hit resumes at
+                        # == prompt_len, i.e. skips the draft phase.
+                        slot_dblocks = list(dhit.blocks) + dblocks
+                        draft_start = dhit.tokens
                     drow = np.zeros((self.engine.max_blocks_per_slot,),
                                     np.int32)
-                    drow[:len(dblocks)] = dblocks
+                    drow[:len(slot_dblocks)] = slot_dblocks
                     self.draft_block_tables[slot] = drow
                     # only spec-mode engines need (or accept) the draft
                     # row — non-spec engine doubles keep the old signature
                     spec_kw["draft_block_row"] = drow
+                    if self.draft_prefix_cache is not None:
+                        spec_kw["draft_start_pos"] = draft_start
                 if self.prefix_cache is not None:
                     # only cache-aware engines accept the offset kwarg —
                     # test doubles without enable_prefix_cache never see it
@@ -663,14 +749,19 @@ class Scheduler:
                     self.allocator.free(slot_blocks)
                     self.block_tables[slot] = 0
                     if self.spec_k:
-                        self.draft_allocator.free(dblocks)
+                        self.draft_allocator.free(slot_dblocks)
                         self.draft_block_tables[slot] = 0
                     self.queue.appendleft((req, submitted_at))
                     self.stop_admission()
                     return
                 self._slot_blocks[slot] = slot_blocks
                 if self.spec_k:
-                    self._slot_draft_blocks[slot] = dblocks
+                    self._slot_draft_blocks[slot] = slot_dblocks
+                    if self.draft_prefix_cache is not None:
+                        self.draft_prefix_cache.insert(req.prompt,
+                                                       slot_dblocks)
+                        self.draft_prefix_cache.note_admission(
+                            draft_start, len(req.prompt))
                 if self.prefix_cache is not None:
                     self.prefix_cache.insert(req.prompt, slot_blocks)
                     self.prefix_cache.note_admission(start_pos,
@@ -819,20 +910,42 @@ class Scheduler:
             for s, st in self.active.items():
                 lengths[s] = len(st.request.prompt) + len(st.tokens) - 1
             round_k = self.spec_k
-            spec_kw = {}
             if self.adaptive_k is not None:
                 round_k = self.adaptive_k.round_k(
                     st.request.id for st in self.active.values())
-                # only ladder-aware engines take the width kwarg — test
-                # doubles built before adaptive-k keep the old signature
-                spec_kw["k"] = round_k
             self._m_spec_round_k.set(round_k)
-            out, acc = self.engine.spec_round(
-                tokens, lengths, active, temperature, top_p, seeds, steps,
-                block_tables=self.block_tables,
-                draft_block_tables=self.draft_block_tables, **spec_kw)
-            self.decode_dispatches += 2  # draft-k + verify programs
-            self.decode_host_syncs += 1  # one (out, acc) sync per round
+            if self.spec_tree is not None:
+                # TREE round: the adaptive budget maps to a deterministic
+                # sub-shape of the configured tree; the refeed window
+                # carries each slot's previously banked tokens (bonus
+                # last) so the draft rewrites their KV before proposing.
+                tree_shape = (self.spec_tree if self.adaptive_k is None
+                              else self.spec_tree.shrink_to(round_k))
+                r_w = self.engine._tree_refeed
+                refeed = np.zeros((slots, r_w), np.int32)
+                refeed_len = np.ones((slots,), np.int32)
+                for s, st in self.active.items():
+                    em = st.emitted[-r_w:]
+                    refeed[s, :len(em)] = em
+                    refeed_len[s] = len(em)
+                out, acc, path = self.engine.spec_tree_round(
+                    refeed, refeed_len, lengths, active, temperature,
+                    top_p, seeds, steps, block_tables=self.block_tables,
+                    draft_block_tables=self.draft_block_tables,
+                    shape=tree_shape)
+            else:
+                spec_kw = {}
+                if self.adaptive_k is not None:
+                    # only ladder-aware engines take the width kwarg —
+                    # test doubles built before adaptive-k keep the old
+                    # signature
+                    spec_kw["k"] = round_k
+                out, acc = self.engine.spec_round(
+                    tokens, lengths, active, temperature, top_p, seeds,
+                    steps, block_tables=self.block_tables,
+                    draft_block_tables=self.draft_block_tables, **spec_kw)
+            self.decode_dispatches += 2  # draft + verify programs
+            self.decode_host_syncs += 1  # one result sync per round
             self._m_dispatches.inc(2)
             self._m_host_syncs.inc()
         elif self.kv_layout == "paged" and self.decode_burst > 1:
@@ -880,7 +993,10 @@ class Scheduler:
             self._m_tps.set(self._m_tokens.value / wall)
         self.iterations += 1
         if self.spec_k:
-            self._bank_spec(out, acc, done, k=round_k)
+            if self.spec_tree is not None:
+                self._bank_tree(out, acc, path, tree_shape, done)
+            else:
+                self._bank_spec(out, acc, done, k=round_k)
             return done
         if burst_out is not None:
             self._bank_burst(burst_out, done)
@@ -982,6 +1098,73 @@ class Scheduler:
             self._m_spec_rate.set(
                 self.spec_accepted_tokens / self.spec_draft_tokens)
 
+    def _bank_tree(self, out: np.ndarray, acc: np.ndarray, path: np.ndarray,
+                   shape, done: List[Completion]) -> None:
+        """Bank one TREE round under ``_bank_spec``'s truncation contract.
+        The round proposed ``sum(fanouts)`` draft tokens (the tree minus
+        its root) and scored ``shape.size`` nodes in one verify dispatch;
+        ``path[s, :acc[s]]`` names the accepted nodes' tree rows, which is
+        what attributes acceptance to branches — a row off
+        ``shape.primary_rows`` is a token linear speculation would have
+        thrown away with the rejected suffix. The banked tokens become the
+        slot's refeed window for the next round."""
+        budget = shape.size - 1
+        self.spec_rounds += 1
+        self.spec_tree_rounds += 1
+        n_active = len(self.active)
+        self.spec_draft_tokens += budget * n_active
+        self._m_spec_draft.inc(budget * n_active)
+        self.spec_tree_nodes += shape.size * n_active
+        self._m_tree_nodes.inc(shape.size * n_active)
+        primary = shape.primary_rows
+        round_accepted = 0
+        for s in list(self.active):
+            st = self.active[s]
+            a = int(acc[s])
+            st.steps += 1
+            st.spec_proposed += budget
+            st.spec_accepted += a
+            round_accepted += a
+            self._m_tree_path_len.observe(a)
+            self.spec_tree_accepted += a
+            self.spec_tree_off_primary += sum(
+                1 for j in range(a) if int(path[s, j]) != primary[j])
+            if self.adaptive_k is not None:
+                self.adaptive_k.observe(st.request.id, a, shape.depth)
+            banked = 0
+            finished = None
+            emitted: List[int] = []
+            for i in range(a + 1):
+                tok = int(out[s, i])
+                st.tokens.append(tok)
+                emitted.append(tok)
+                banked += 1
+                self._m_tokens.inc()
+                if i == a:
+                    # the verifier's own token (bonus or correction) —
+                    # emitted without ever having been proposed
+                    st.spec_corrected += 1
+                if self.eos_token_id is not None and tok == self.eos_token_id:
+                    finished = "eos"
+                    break
+                if len(st.tokens) >= st.request.max_new_tokens:
+                    finished = "length"
+                    break
+            st.emitted = emitted
+            self.decode_tokens += banked
+            self._m_spec_round_tokens.observe(banked)
+            self._m_burst_tokens.observe(banked)
+            if finished:
+                self._finish(s, finished, done)
+        self.spec_accepted_tokens += round_accepted
+        self._m_spec_accepted.inc(round_accepted)
+        if self.spec_draft_tokens:
+            self._m_spec_rate.set(
+                self.spec_accepted_tokens / self.spec_draft_tokens)
+        if self.spec_tree_accepted:
+            self._m_tree_branch_util.set(
+                self.spec_tree_off_primary / self.spec_tree_accepted)
+
     def run(self, stop: Optional[Callable[[], bool]] = None
             ) -> List[Completion]:
         """Drive until idle; ``stop()`` returning True switches to drain
@@ -1000,11 +1183,11 @@ class Scheduler:
 
     def audit_block_leaks(self, strict: bool = True) -> List[str]:
         """Allocator leak guard for the drained/idle state (no active
-        slots): every target-pool block must be either free or held solely
-        by the prefix cache (exactly one reference), and the draft pool —
-        which opts out of caching — must be fully free. Violations are
-        audited ONCE (``[KV LEAK]``) through the flight recorder and, in
-        strict mode, raised. Returns the violation descriptions."""
+        slots): every block in EITHER pool must be either free or held
+        solely by its pool's prefix cache (exactly one reference — the
+        draft pool runs the mirror cache, module docstring). Violations
+        are audited ONCE (``[KV LEAK]``) through the flight recorder and,
+        in strict mode, raised. Returns the violation descriptions."""
         if self.kv_layout != "paged" or self.active or self._pending_prefill:
             return []
         leaks: List[str] = []
@@ -1015,11 +1198,15 @@ class Scheduler:
             leaks.append(AUDIT_KV_LEAK_FMT.format(
                 pool="target", leaked=extra,
                 used=self.allocator.used_count, cached=cached))
-        if self.spec_k and (self.draft_allocator.used_count
-                            or self._slot_draft_blocks):
-            leaks.append(AUDIT_KV_LEAK_FMT.format(
-                pool="draft", leaked=self.draft_allocator.used_count,
-                used=self.draft_allocator.used_count, cached=0))
+        if self.spec_k:
+            dcached = (self.draft_prefix_cache.cached_blocks
+                       if self.draft_prefix_cache is not None else 0)
+            dextra = self.draft_allocator.used_count - dcached
+            if (dextra != 0 or self.draft_allocator.shared_count
+                    or self._slot_draft_blocks):
+                leaks.append(AUDIT_KV_LEAK_FMT.format(
+                    pool="draft", leaked=dextra,
+                    used=self.draft_allocator.used_count, cached=dcached))
         if leaks and not self._leak_audited:
             self._leak_audited = True
             for text in leaks:
@@ -1094,4 +1281,23 @@ class Scheduler:
                 if self.spec_draft_tokens else 0.0)
             out["draft_kv_blocks_total"] = self.draft_allocator.capacity
             out["draft_kv_blocks_free"] = self.draft_allocator.free_count
+            if self.draft_prefix_cache is not None:
+                dpc = self.draft_prefix_cache
+                out["draft_prefix_hits"] = dpc.hits
+                out["draft_prefix_hit_tokens"] = dpc.hit_tokens
+                out["draft_prefix_hit_rate"] = dpc.hit_rate
+                out["draft_prefix_cached_blocks"] = dpc.cached_blocks
+            if self.spec_tree is not None:
+                out["spec_tree"] = ",".join(
+                    str(f) for f in self.spec_tree.fanouts)
+                out["spec_tree_rounds"] = self.spec_tree_rounds
+                out["spec_tree_nodes"] = self.spec_tree_nodes
+                out["spec_tree_accepted_off_primary"] = (
+                    self.spec_tree_off_primary)
+                out["spec_tree_branch_utilization"] = (
+                    self.spec_tree_off_primary / self.spec_tree_accepted
+                    if self.spec_tree_accepted else 0.0)
+                out["spec_accepted_per_round"] = (
+                    self.spec_accepted_tokens / self.spec_rounds
+                    if self.spec_rounds else 0.0)
         return out

@@ -49,6 +49,7 @@ from ..utils.logging import (
     AUDIT_SERVE_READY_FMT,
     AUDIT_SERVE_START,
     AUDIT_SERVE_STEP_FMT,
+    AUDIT_SERVE_TREE_SPEC_FMT,
     init_logger,
     logger,
 )
@@ -240,6 +241,20 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "FLOPs, but bf16 GEMM accumulation is shape-"
                         "dependent and a one-ulp near-tie can flip an "
                         "argmax vs the S=1 decode program")
+    p.add_argument("--spec-tree", default="",
+                   help="TREE speculative decoding: comma list of per-depth "
+                        "branch fan-outs (e.g. '2,2,1') — the draft's "
+                        "k-chain plus free top-k sibling fan-outs, all "
+                        "scored by ONE ancestor-masked verify dispatch; an "
+                        "accepted sibling rescues a round linear "
+                        "speculation would have cut short. '' = linear "
+                        "--spec-k rounds. Requires --spec-k; '1,1,...' "
+                        "degenerates to the linear chain. With "
+                        "--adaptive-spec-k the controller's budget picks a "
+                        "sub-shape per round (TreeShape.shrink_to). Under "
+                        "--spec-verify-impl exact only the primary chain "
+                        "is scored (greedy streams bit-match --spec-k 0 by "
+                        "construction); 'chunk' scores every branch")
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=1.0)
@@ -343,7 +358,11 @@ def main(argv=None) -> None:
                 draft_cfg=draft_cfg, draft_params=draft_params,
                 spec_k=args.spec_k,
                 draft_num_blocks=args.draft_kv_num_blocks or None,
-                spec_verify_impl=args.spec_verify_impl)
+                spec_verify_impl=args.spec_verify_impl,
+                spec_tree=args.spec_tree or None)
+        elif args.spec_tree:
+            raise SystemExit("--spec-tree requires --spec-k (the tree "
+                             "widens the speculative rounds)")
         engine = InferenceEngine.from_checkpoint(
             args.checkpoint_path, args.checkpoint_job_id, cfg,
             step=args.step, slots=args.slots,
@@ -357,9 +376,10 @@ def main(argv=None) -> None:
         if args.spec_k:
             engine.draft_restored_step = draft_step_restored
             logger.info(
-                "Speculative decoding | draft=%s step=%s k=%d verify=%s",
+                "Speculative decoding | draft=%s step=%s k=%d verify=%s "
+                "tree=%s",
                 args.draft_preset, draft_step_restored, args.spec_k,
-                args.spec_verify_impl)
+                args.spec_verify_impl, args.spec_tree or "off")
         events.emit_audit(
             logger, AUDIT_SERVE_READY_FMT.format(
                 model=args.model, step=engine.restored_step,
@@ -500,6 +520,21 @@ def main(argv=None) -> None:
             "acceptance %.3f", m["spec_k"], m["spec_rounds"],
             m["spec_draft_tokens"], m["spec_accepted_tokens"],
             m["spec_acceptance_rate"])
+        if args.spec_tree:
+            # tree-widening receipt in the drain summary: nodes scored per
+            # verify dispatch, accepted tokens per round (the perf claim),
+            # and how much of the acceptance came OFF the primary chain —
+            # the rescue linear speculation cannot make
+            events.emit_audit(
+                logger, AUDIT_SERVE_TREE_SPEC_FMT.format(
+                    shape=m["spec_tree"], rounds=m["spec_tree_rounds"],
+                    nodes=m["spec_tree_nodes"],
+                    per_round=m["spec_accepted_per_round"],
+                    util=m["spec_tree_branch_utilization"]),
+                "tree_spec", shape=m["spec_tree"],
+                rounds=m["spec_tree_rounds"], nodes=m["spec_tree_nodes"],
+                accepted_per_round=m["spec_accepted_per_round"],
+                branch_utilization=m["spec_tree_branch_utilization"])
     if sched.prefill_batch > 1:
         # packed-lane occupancy in the drain receipt: how full the packed
         # prefill dispatches ran, and which kernel their paged reads took

@@ -215,7 +215,38 @@ class Attention(nn.Module):
             # tuple arity (inference/kv_cache.py):
             #   (k, v, offsets)                 per-slot ring buffers
             #   (k, v, tables, offsets, valid)  paged block pool
+            #   (k, v, tables, offsets, valid, positions, anc)
+            #       paged TREE-verify: per-row rope positions + ancestor
+            #       visibility over the speculative window
             from ..inference.kv_cache import write_paged_kv, write_slot_kv
+            if len(cache) == 7:
+                # Tree-verify: the S rows are one flattened token tree.
+                # Node i's KV lands at cache position ``offsets[b] + i``
+                # (contiguous — write_paged_kv unchanged) but its ROPE
+                # position is ``offsets[b] + depth(i)``: rope encodes the
+                # node's distance down its root path, not its row index,
+                # so an accepted path's keys are rotated exactly as the
+                # sequential decode would have rotated them. Attention
+                # swaps the causal rule for the (S, S) ancestor mask.
+                (k_pool, v_pool, block_tables, offsets, write_valid,
+                 tree_positions, anc_mask) = cache
+                t = block_tables.shape[1] * k_pool.shape[2]
+                cos, sin = precompute_rope(dh, t, cfg.rope_theta)
+                q = apply_rope(q, cos, sin, positions=tree_positions)
+                k = apply_rope(k, cos, sin, positions=tree_positions)
+                k_pool = write_paged_kv(
+                    k_pool, jnp.transpose(k, (0, 2, 1, 3)), block_tables,
+                    offsets, write_valid)
+                v_pool = write_paged_kv(
+                    v_pool, jnp.transpose(v, (0, 2, 1, 3)), block_tables,
+                    offsets, write_valid)
+                from ..ops.attention import paged_tree_attention
+                out = paged_tree_attention(q, k_pool, v_pool, block_tables,
+                                           offsets, anc_mask,
+                                           impl=cfg.paged_kernel)
+                out = out.reshape(b, s, cfg.n_heads * dh)
+                return (nn.Dense(cfg.dim, name="wo", **dense)(out),
+                        (k_pool, v_pool))
             if len(cache) == 5:
                 k_pool, v_pool, block_tables, offsets, write_valid = cache
                 # Table rows cover ceil(max_len/bs) blocks; rope rows are
@@ -553,6 +584,46 @@ class Transformer(nn.Module):
         return self.forward_with_cache(tokens, cache_k, cache_v, offsets,
                                        block_tables=block_tables,
                                        write_valid=write_valid)
+
+    def tree_verify_with_cache(self, tokens, cache_k, cache_v, offsets,
+                               block_tables, tree_positions, anc_mask,
+                               write_valid=None):
+        """Tree-speculative verify: score one flattened S-node token TREE
+        per slot in a single forward through the paged caches.
+
+        ``tokens`` (B, S) is ``[last_committed, node_1 .. node_{S-1}]`` in
+        topological order; node i's KV is written at cache position
+        ``offsets[b] + i`` while its rope position is ``tree_positions[b,
+        i] = offsets[b] + depth(i)``, and attention inside the speculative
+        window follows ``anc_mask`` (S, S) — ancestors ∪ self ∪ root —
+        instead of the causal rule (ops/attention.py
+        ``paged_tree_attention``). Row i's logits are therefore the
+        target's next-token law after node i's root path, for EVERY branch
+        of the tree in one dispatch. When the tree degenerates to a chain
+        the mask equals the causal one and this reproduces
+        :meth:`verify_with_cache` bit-for-bit on the gather impl (the
+        chunk-mode caveat there about bf16 shape-dependent accumulation
+        vs S=1 micro-steps applies unchanged — hence the engine's
+        ``exact`` escape hatch scores only the primary chain).
+        """
+        if block_tables is None:
+            raise ValueError("tree_verify_with_cache requires the paged "
+                             "layout (block_tables)")
+        if self.cfg.layer_impl != "loop":
+            raise ValueError(
+                "tree_verify_with_cache requires layer_impl='loop'; convert "
+                "scan-form checkpoints with unstack_layer_params")
+        if write_valid is None:
+            write_valid = jnp.ones(tokens.shape, jnp.bool_)
+        x = self.embed(tokens)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            c = (cache_k[i], cache_v[i], block_tables, offsets, write_valid,
+                 tree_positions, anc_mask)
+            x, (k_i, v_i) = layer(x, None, c)
+            new_k.append(k_i)
+            new_v.append(v_i)
+        return self.head(x), (tuple(new_k), tuple(new_v))
 
 
 def stack_layer_params(params: dict, n_layers: int) -> dict:

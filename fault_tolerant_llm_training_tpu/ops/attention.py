@@ -92,6 +92,52 @@ def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     return out.reshape(b, s_q, h, d).astype(q.dtype)
 
 
+def tree_cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                          v_cache: jnp.ndarray, offsets: jnp.ndarray,
+                          anc_mask: jnp.ndarray) -> jnp.ndarray:
+    """:func:`cached_attention` with a per-row ANCESTOR mask over the
+    speculative window — the tree-verify attention rule.
+
+    q:        (B, S, H, D) — the round's flattened token tree, row 0 the
+              committed last token (root), rows 1..S-1 draft proposals in
+              topological order; node i's KV sits at cache position
+              ``offsets[b] + i`` (written contiguously, like any chunk).
+    anc_mask: (S, S) bool — ``anc_mask[r, j]`` iff tree row j is on row
+              r's root path (ancestors ∪ self ∪ root), so siblings and
+              cousins never see each other's keys.
+
+    The mask replaces the chunk kernel's pure causal rule: row r attends
+    every COMMITTED key (``k_pos < offsets[b]``) exactly as before, plus
+    the speculative-window keys ``offsets[b] + j`` with ``anc_mask[r, j]``
+    set; keys past the window stay masked. Everything else — grouped
+    einsum, fp32 softmax, additive ``finfo.min`` mask with exact-zero
+    masked probabilities — is :func:`cached_attention` byte for byte, so
+    a tree whose mask happens to be the causal chain reproduces the
+    linear verify bit-for-bit.
+    """
+    b, s_q, h, d = q.shape
+    _, kv, t, _ = k_cache.shape
+    g = h // kv
+    qg = q.reshape(b, s_q, kv, g, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bqkgd,bktd->bkgqt", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(t, dtype=jnp.int32)[None, None, :]        # (1, 1, T)
+    node = k_pos - offsets[:, None, None]                        # (B, 1, T)
+    committed = node < 0
+    in_window = (node >= 0) & (node < s_q)
+    tree_vis = jnp.transpose(
+        anc_mask[:, jnp.clip(node[:, 0, :], 0, s_q - 1)],        # (S, B, T)
+        (1, 0, 2))                                               # (B, S, T)
+    visible = committed | (in_window & tree_vis)
+    mask = jnp.where(visible, 0.0, jnp.finfo(jnp.float32).min)
+    scores = scores + mask[:, None, None, :, :]
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqt,bktd->bqkgd", probs, v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s_q, h, d).astype(q.dtype)
+
+
 def gather_kv_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray
                      ) -> jnp.ndarray:
     """Assemble per-slot contiguous KV views from a paged block pool.
@@ -148,6 +194,33 @@ def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     """
     return cached_attention(q, gather_kv_blocks(k_pool, block_tables),
                             gather_kv_blocks(v_pool, block_tables), offsets)
+
+
+def paged_tree_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                         v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                         offsets: jnp.ndarray, anc_mask: jnp.ndarray,
+                         impl: str = "gather") -> jnp.ndarray:
+    """:func:`tree_cached_attention` against block-paged KV pools — the
+    tree-verify routing point, mirroring :func:`paged_attention`.
+
+    ``"gather"`` assembles each slot's blocks and runs the bit-exact
+    reference above; ``"pallas"`` takes the ancestor-masked chunk kernel
+    (ops/paged_attention.py ``paged_tree_chunk_attention``), which reads
+    pool blocks in place through the table and carries the (S, S) mask as
+    a packed per-row int32 bitmask — equal within fp32 accumulation
+    tolerance and bitwise invariant to masked bytes, like every other
+    pallas lane.
+    """
+    if impl == "gather":
+        return tree_cached_attention(
+            q, gather_kv_blocks(k_pool, block_tables),
+            gather_kv_blocks(v_pool, block_tables), offsets, anc_mask)
+    if impl == "pallas":
+        from .paged_attention import paged_tree_chunk_attention
+        return paged_tree_chunk_attention(q, k_pool, v_pool, block_tables,
+                                          offsets, anc_mask)
+    raise ValueError(f"unknown paged attention impl: {impl!r} "
+                     f"(want 'gather' or 'pallas')")
 
 
 def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,

@@ -20,6 +20,10 @@ paged_attention(impl=)`` on the query length:
 - :func:`paged_chunk_attention` — S > 1 (chunked prefill, chunk-mode
   spec-verify): the same grid, the q block widened to the chunk's
   S*G rows, the causal boundary applied per row.
+- :func:`paged_tree_chunk_attention` — S > 1 TREE-verify (tree
+  speculative decoding): the chunk kernel with the speculative window's
+  causal rule replaced by a per-row ancestor mask, dispatched by
+  ``ops/attention.py paged_tree_attention(impl=)``.
 
 MASKING (the single statement of the rationale, for both kernels and
 for the gather reference that ops/attention.py keeps selectable):
@@ -65,15 +69,30 @@ from .flash_attention import LOG2E, NEG_INF, _interpret
 # 128 anyway, and a (G, 128) broadcast store beats a strided (G, 1) one.
 _STAT_LANES = 128
 
+# Mosaic tile knobs (ROADMAP D=128 tile-tuning follow-up): how many kv
+# heads one grid step processes. A tile of T fuses T heads' (bs, D) KV
+# DMAs and dots into one step — fewer grid steps, larger VMEM tiles —
+# at T× the scratch. scripts/d128_tile_sweep.py sweeps these under
+# interpret mode; 1 is the recorded CPU-interpret-safe default (the
+# sweep found no CPU win above it, and 1 keeps each step's numerics and
+# scratch identical to the pre-knob kernels). A tile that does not
+# divide the pool's kv-head count falls back to 1.
+DECODE_HEAD_TILE = 1
+CHUNK_HEAD_TILE = 1
+
 
 def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, block_size: int, scale: float):
-    """One (slot b, kv-head h, logical block j) grid step.
+                   m_scr, l_scr, acc_scr, *, block_size: int, scale: float,
+                   head_tile: int = 1):
+    """One (slot b, kv-head tile h, logical block j) grid step.
 
-    k_ref/v_ref are the (1, 1, bs, D) pool slices the index map already
-    aimed at ``tables[b, j]`` — the kernel never sees a block id, only
-    the block's bytes. Carry (m, l, acc) lives in VMEM scratch revisited
-    across the innermost j axis; j == 0 initializes, the last j emits.
+    k_ref/v_ref are the (1, head_tile, bs, D) pool slices the index map
+    already aimed at ``tables[b, j]`` — the kernel never sees a block id,
+    only the block's bytes. Carry (m, l, acc) lives in VMEM scratch
+    revisited across the innermost j axis (one (G, ·) band per tiled
+    head); j == 0 initializes, the last j emits. The head loop is a
+    static Python unroll, so ``head_tile == 1`` is instruction-for-
+    instruction the pre-knob kernel.
     """
     b = pl.program_id(0)
     j = pl.program_id(2)
@@ -85,39 +104,46 @@ def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     offset = offs_ref[b]  # this slot's decode position (committed length)
+    g = acc_scr.shape[0] // head_tile
 
     # Blocks whose first position is already past the query position are
     # fully masked — skip them (freed/stale/null-table tail). The carry
     # is untouched, exactly as an all -inf block contributes nothing.
     @pl.when(j * block_size <= offset)
     def _block():
-        q2 = (q_ref[0, 0].astype(jnp.float32)
-              * (scale * LOG2E)).astype(q_ref.dtype)       # (G, D)
-        s = jax.lax.dot_general(                           # (G, bs) fp32
-            q2, k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        g = s.shape[0]
-        k_pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (g, block_size), 1)
-        s = jnp.where(k_pos <= offset, s, NEG_INF)
-        m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp2(s - m_new[:, None])
-        alpha = jnp.exp2(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_scr[...] = (acc_scr[...] * alpha[:, None]
-                        + jax.lax.dot_general(
-                            p.astype(v_ref.dtype), v_ref[0, 0],
-                            (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32))
-        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        for hh in range(head_tile):
+            lo, hi = hh * g, (hh + 1) * g
+            q2 = (q_ref[0, hh].astype(jnp.float32)
+                  * (scale * LOG2E)).astype(q_ref.dtype)       # (G, D)
+            s = jax.lax.dot_general(                           # (G, bs) fp32
+                q2, k_ref[0, hh], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            k_pos = j * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (g, block_size), 1)
+            s = jnp.where(k_pos <= offset, s, NEG_INF)
+            m_prev, l_prev = m_scr[lo:hi, 0], l_scr[lo:hi, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc_scr[lo:hi, :] = (acc_scr[lo:hi, :] * alpha[:, None]
+                                 + jax.lax.dot_general(
+                                     p.astype(v_ref.dtype), v_ref[0, hh],
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32))
+            m_scr[lo:hi, :] = jnp.broadcast_to(
+                m_new[:, None], (g, m_scr.shape[1]))
+            l_scr[lo:hi, :] = jnp.broadcast_to(
+                l_new[:, None], (g, l_scr.shape[1]))
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _emit():
         # l >= exp2(0) always: position ``offset`` itself is in range
         # (the decode writes the query token's KV before attending).
-        o_ref[0, 0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+        for hh in range(head_tile):
+            lo, hi = hh * g, (hh + 1) * g
+            o_ref[0, hh] = (acc_scr[lo:hi, :]
+                            / l_scr[lo:hi, :1]).astype(o_ref.dtype)
 
 
 def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -148,32 +174,33 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     n, kv, bs, _ = k_pool.shape
     g = h // kv
     nb = block_tables.shape[1]
+    ht = DECODE_HEAD_TILE if kv % DECODE_HEAD_TILE == 0 else 1
     qg = q.reshape(b, kv, g, d)  # head-major: (B, K, G, D)
     tables = block_tables.reshape(-1).astype(jnp.int32)
     offs = offsets.astype(jnp.int32)
     kernel = functools.partial(_decode_kernel, block_size=bs,
-                               scale=1.0 / math.sqrt(d))
+                               scale=1.0 / math.sqrt(d), head_tile=ht)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b, kv, nb),
+            grid=(b, kv // ht, nb),
             in_specs=[
-                pl.BlockSpec((1, 1, g, d),
+                pl.BlockSpec((1, ht, g, d),
                              lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
-                pl.BlockSpec((1, 1, bs, d),
+                pl.BlockSpec((1, ht, bs, d),
                              lambda bi, hi, j, t, o: (t[bi * nb + j],
                                                       hi, 0, 0)),
-                pl.BlockSpec((1, 1, bs, d),
+                pl.BlockSpec((1, ht, bs, d),
                              lambda bi, hi, j, t, o: (t[bi * nb + j],
                                                       hi, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, g, d),
+            out_specs=pl.BlockSpec((1, ht, g, d),
                                    lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((g, _STAT_LANES), jnp.float32),  # m
-                pltpu.VMEM((g, _STAT_LANES), jnp.float32),  # l
-                pltpu.VMEM((g, d), jnp.float32),            # acc
+                pltpu.VMEM((ht * g, _STAT_LANES), jnp.float32),  # m
+                pltpu.VMEM((ht * g, _STAT_LANES), jnp.float32),  # l
+                pltpu.VMEM((ht * g, d), jnp.float32),            # acc
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, g, d), q.dtype),
@@ -184,17 +211,18 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
 def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, block_size: int, group: int,
-                  s_q: int, scale: float):
-    """One (slot b, kv-head h, logical block j) grid step, S > 1 rows.
+                  s_q: int, scale: float, head_tile: int = 1):
+    """One (slot b, kv-head tile h, logical block j) grid step, S > 1 rows.
 
-    The q block is the chunk's S*G rows for this kv head, s-major: row r
-    is query position ``offsets[b] + r // group``, group member
-    ``r % group``. Same online-softmax carry as :func:`_decode_kernel`,
-    but the causal boundary is applied PER ROW — one iota-derived q_pos
-    column against the block's k_pos row — and the wholesale block skip
-    keys off the LAST row's boundary (a block any row can see must run;
-    rows that can't see it get every lane masked, exp2 underflows to 0.0
-    exactly, their carry is untouched).
+    The q block is the chunk's S*G rows for each tiled kv head, s-major:
+    row r is query position ``offsets[b] + r // group``, group member
+    ``r % group``. Same online-softmax carry as :func:`_decode_kernel`
+    (one rows-band per tiled head, statically unrolled), but the causal
+    boundary is applied PER ROW — one iota-derived q_pos column against
+    the block's k_pos row — and the wholesale block skip keys off the
+    LAST row's boundary (a block any row can see must run; rows that
+    can't see it get every lane masked, exp2 underflows to 0.0 exactly,
+    their carry is untouched).
     """
     b = pl.program_id(0)
     j = pl.program_id(2)
@@ -206,38 +234,45 @@ def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     offset = offs_ref[b]  # this slot's chunk start (first row's position)
+    rows = s_q * group
 
     @pl.when(j * block_size <= offset + (s_q - 1))
     def _block():
-        rows = s_q * group
-        q2 = (q_ref[0, 0].astype(jnp.float32)
-              * (scale * LOG2E)).astype(q_ref.dtype)       # (rows, D)
-        s = jax.lax.dot_general(                           # (rows, bs) fp32
-            q2, k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        k_pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (rows, block_size), 1)
-        q_pos = offset + jax.lax.broadcasted_iota(
-            jnp.int32, (rows, block_size), 0) // group
-        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp2(s - m_new[:, None])
-        alpha = jnp.exp2(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_scr[...] = (acc_scr[...] * alpha[:, None]
-                        + jax.lax.dot_general(
-                            p.astype(v_ref.dtype), v_ref[0, 0],
-                            (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32))
-        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        for hh in range(head_tile):
+            lo, hi = hh * rows, (hh + 1) * rows
+            q2 = (q_ref[0, hh].astype(jnp.float32)
+                  * (scale * LOG2E)).astype(q_ref.dtype)       # (rows, D)
+            s = jax.lax.dot_general(                           # (rows, bs)
+                q2, k_ref[0, hh], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            k_pos = j * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, block_size), 1)
+            q_pos = offset + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, block_size), 0) // group
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            m_prev, l_prev = m_scr[lo:hi, 0], l_scr[lo:hi, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc_scr[lo:hi, :] = (acc_scr[lo:hi, :] * alpha[:, None]
+                                 + jax.lax.dot_general(
+                                     p.astype(v_ref.dtype), v_ref[0, hh],
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32))
+            m_scr[lo:hi, :] = jnp.broadcast_to(
+                m_new[:, None], (rows, m_scr.shape[1]))
+            l_scr[lo:hi, :] = jnp.broadcast_to(
+                l_new[:, None], (rows, l_scr.shape[1]))
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _emit():
         # l >= exp2(0) for every row: k_pos = 0 satisfies the row's own
         # boundary (offset >= 0), and block 0 always runs.
-        o_ref[0, 0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+        for hh in range(head_tile):
+            lo, hi = hh * rows, (hh + 1) * rows
+            o_ref[0, hh] = (acc_scr[lo:hi, :]
+                            / l_scr[lo:hi, :1]).astype(o_ref.dtype)
 
 
 def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -272,6 +307,7 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     g = h // kv
     nb = block_tables.shape[1]
     rows = s_q * g
+    ht = CHUNK_HEAD_TILE if kv % CHUNK_HEAD_TILE == 0 else 1
     # s-major rows per kv head: (B, S, K, G, D) -> (B, K, S*G, D), so row
     # r is (position r // g, group member r % g) — what the kernel's
     # per-row q_pos iota assumes.
@@ -280,6 +316,142 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     tables = block_tables.reshape(-1).astype(jnp.int32)
     offs = offsets.astype(jnp.int32)
     kernel = functools.partial(_chunk_kernel, block_size=bs, group=g,
+                               s_q=s_q, scale=1.0 / math.sqrt(d),
+                               head_tile=ht)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kv // ht, nb),
+            in_specs=[
+                pl.BlockSpec((1, ht, rows, d),
+                             lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, ht, bs, d),
+                             lambda bi, hi, j, t, o: (t[bi * nb + j],
+                                                      hi, 0, 0)),
+                pl.BlockSpec((1, ht, bs, d),
+                             lambda bi, hi, j, t, o: (t[bi * nb + j],
+                                                      hi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, ht, rows, d),
+                                   lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((ht * rows, _STAT_LANES), jnp.float32),  # m
+                pltpu.VMEM((ht * rows, _STAT_LANES), jnp.float32),  # l
+                pltpu.VMEM((ht * rows, d), jnp.float32),            # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, rows, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(tables, offs, qr, k_pool, v_pool)
+    return (out.reshape(b, kv, s_q, g, d)
+            .transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, d))
+
+
+def _tree_kernel(tables_ref, offs_ref, q_ref, anc_ref, k_ref, v_ref, o_ref,
+                 m_scr, l_scr, acc_scr, *, block_size: int, group: int,
+                 s_q: int, scale: float):
+    """:func:`_chunk_kernel` with the causal rule swapped for the tree's
+    ANCESTOR rule (tree-verify: the q rows are one flattened token tree).
+
+    Row r (tree node ``r // group``) attends every committed key
+    (``k_pos < offset``) and, inside the speculative window
+    ``[offset, offset + s_q)``, exactly the keys of the nodes on its root
+    path: ``anc_ref[r // group, j]`` gates window key ``offset + j``.
+    The mask is built by a static unroll over the s_q window nodes — an
+    equality compare against each node's k_pos AND'd with that node's
+    ancestor column — so sibling/cousin keys are NEG_INF'd and underflow
+    to exact zero probability like every other masked lane; the block
+    skip and the online-softmax carry are the chunk kernel's unchanged.
+    Every row sees at least its own key (``anc[r, r]`` is set), so l > 0
+    at emit.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    offset = offs_ref[b]  # committed length: the root row's position
+    rows = s_q * group
+
+    @pl.when(j * block_size <= offset + (s_q - 1))
+    def _block():
+        q2 = (q_ref[0, 0].astype(jnp.float32)
+              * (scale * LOG2E)).astype(q_ref.dtype)       # (rows, D)
+        s = jax.lax.dot_general(                           # (rows, bs) fp32
+            q2, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1)
+        vis = k_pos < offset                               # committed keys
+        for t_node in range(s_q):
+            col = jnp.broadcast_to(anc_ref[:, t_node:t_node + 1],
+                                   (s_q, group)).reshape(rows, 1)
+            vis = vis | ((k_pos == offset + t_node) & (col > 0))
+        s = jnp.where(vis, s, NEG_INF)
+        m_prev, l_prev = m_scr[:, 0], l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = (acc_scr[...] * alpha[:, None]
+                        + jax.lax.dot_general(
+                            p.astype(v_ref.dtype), v_ref[0, 0],
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def paged_tree_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                               v_pool: jnp.ndarray,
+                               block_tables: jnp.ndarray,
+                               offsets: jnp.ndarray, anc_mask: jnp.ndarray,
+                               interpret: bool = None) -> jnp.ndarray:
+    """Tree-verify paged attention reading pool blocks in place.
+
+    The ancestor-masked sibling of :func:`paged_chunk_attention`: same
+    scalar-prefetched (B, K, NB) grid and s-major q rows, but the per-row
+    causal boundary is replaced by the tree's ancestor rule, carried as a
+    dense (S, S) int32 visibility matrix rider (``anc_mask[r, j]`` != 0
+    iff tree row j — cache position ``offsets[b] + j`` — is on row r's
+    root path; include self and root). Committed keys below ``offsets[b]``
+    attend unconditionally, keys past the window never do, so the gather
+    reference (ops/attention.py ``tree_cached_attention``) and this
+    kernel mask the identical position set — equal to fp32 accumulation
+    tolerance, bitwise invariant to masked bytes (scripts/
+    kernel_checks.py pins both at D=64 and D=128).
+
+    q:        (B, S, H, D) flattened tree rows (rope at depth positions
+              applied, KV written at ``offsets[b] + row``).
+    anc_mask: (S, S) bool/int — static per tree shape; the engine bakes
+              one per compiled tree program.
+    """
+    b, s_q, h, d = q.shape
+    if s_q < 2:
+        raise ValueError(f"paged_tree_chunk_attention wants S > 1, got "
+                         f"S={s_q} (a one-node tree is plain decode)")
+    if anc_mask.shape != (s_q, s_q):
+        raise ValueError(f"anc_mask must be (S, S) = ({s_q}, {s_q}), got "
+                         f"{anc_mask.shape}")
+    n, kv, bs, _ = k_pool.shape
+    g = h // kv
+    nb = block_tables.shape[1]
+    rows = s_q * g
+    qr = (q.reshape(b, s_q, kv, g, d)
+          .transpose(0, 2, 1, 3, 4).reshape(b, kv, rows, d))
+    tables = block_tables.reshape(-1).astype(jnp.int32)
+    offs = offsets.astype(jnp.int32)
+    anc = anc_mask.astype(jnp.int32)
+    kernel = functools.partial(_tree_kernel, block_size=bs, group=g,
                                s_q=s_q, scale=1.0 / math.sqrt(d))
     out = pl.pallas_call(
         kernel,
@@ -289,6 +461,8 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
             in_specs=[
                 pl.BlockSpec((1, 1, rows, d),
                              lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                pl.BlockSpec((s_q, s_q),
+                             lambda bi, hi, j, t, o: (0, 0)),
                 pl.BlockSpec((1, 1, bs, d),
                              lambda bi, hi, j, t, o: (t[bi * nb + j],
                                                       hi, 0, 0)),
@@ -306,6 +480,6 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, rows, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(tables, offs, qr, k_pool, v_pool)
+    )(tables, offs, qr, anc, k_pool, v_pool)
     return (out.reshape(b, kv, s_q, g, d)
             .transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, d))

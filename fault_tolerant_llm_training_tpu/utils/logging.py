@@ -76,6 +76,9 @@ AUDIT_SERVE_PREFIX_FMT = ("Prefix cache | lookups {lookups} | hit rate "
 AUDIT_SERVE_PREFILL_FMT = ("Packed prefill | rounds {rounds} | rows {rows} "
                            "| occupancy {occupancy:.3f} | inplace chunks "
                            "{inplace} | gather chunks {gather}")
+AUDIT_SERVE_TREE_SPEC_FMT = ("Tree spec | shape {shape} | rounds {rounds} "
+                             "| nodes {nodes} | accepted/round "
+                             "{per_round:.2f} | branch util {util:.3f}")
 AUDIT_KV_LEAK_FMT = ("[KV LEAK] {pool} pool: {leaked} block(s) leaked "
                      "after drain ({used} allocated, {cached} "
                      "prefix-cached)")

@@ -8,6 +8,15 @@ fused backward is engaged exactly as the flagship would) across tile
 candidates, on the chip, to decide whether the D=64 constants transfer
 or need a D=128 dispatch branch.
 
+A second section sweeps the SERVING kernels' head-tile knobs
+(ops/paged_attention.py ``DECODE_HEAD_TILE``/``CHUNK_HEAD_TILE``): the
+paged decode and chunk kernels grid over kv heads one at a time by
+default — at D=128 with 4 kv heads a wider per-dispatch head tile may
+amortize the grid's scalar-prefetch overhead. Timed at a serving-shaped
+pool (decode S=1 and the S=6 tree-verify/chunk window), knobs restored
+after the sweep; 1 stays the recorded default unless the chip says
+otherwise.
+
 Run on the TPU:  python scripts/d128_tile_sweep.py
 """
 
@@ -78,6 +87,66 @@ def main():
     results.sort()
     print(f"\nbest: {results[0][1]} ({results[0][0] * 1000:.2f} ms); "
           f"default at {[r for r in results if 'default' in r[1]][0][0] * 1000:.2f} ms")
+
+    _paged_head_tile_sweep()
+
+
+def _paged_head_tile_sweep():
+    """Serving kernels at D=128: DECODE_HEAD_TILE x CHUNK_HEAD_TILE."""
+    import jax
+    import jax.numpy as jnp
+
+    import fault_tolerant_llm_training_tpu.ops.paged_attention as pa
+    from fault_tolerant_llm_training_tpu.utils.sync import hard_sync
+
+    slots, kv, h, bs, nb, d, s_q = 8, 4, 8, 16, 16, 128, 6
+    rng = np.random.default_rng(5)
+    n_pool = slots * nb + 1
+    pool_k = jnp.asarray(rng.standard_normal((n_pool, kv, bs, d)),
+                         jnp.bfloat16)
+    pool_v = jnp.asarray(rng.standard_normal((n_pool, kv, bs, d)),
+                         jnp.bfloat16)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, slots * nb + 1)).reshape(slots, nb)
+        .astype(np.int32))
+    offsets = jnp.asarray(
+        rng.integers(bs, nb * bs - s_q, size=slots).astype(np.int32))
+    q1 = jnp.asarray(rng.standard_normal((slots, 1, h, d)), jnp.bfloat16)
+    qs = jnp.asarray(rng.standard_normal((slots, s_q, h, d)), jnp.bfloat16)
+
+    lanes = (("decode S=1", "DECODE_HEAD_TILE",
+              lambda: jax.jit(pa.paged_decode_attention)),
+             (f"chunk S={s_q}", "CHUNK_HEAD_TILE",
+              lambda: jax.jit(pa.paged_chunk_attention)))
+    print(f"\npaged head-tile sweep (slots={slots} kv={kv} h={h} d={d})")
+    for tag, knob, make in lanes:
+        default = getattr(pa, knob)
+        q = q1 if knob == "DECODE_HEAD_TILE" else qs
+        rows = []
+        for tile in (1, 2, 4):
+            setattr(pa, knob, tile)
+            try:
+                fn = make()              # fresh jit: the knob is baked in
+                out = fn(q, pool_k, pool_v, tables, offsets)
+                hard_sync(out)
+                best = float("inf")
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    for _ in range(50):
+                        out = fn(q, pool_k, pool_v, tables, offsets)
+                    hard_sync(out)
+                    best = min(best, (time.perf_counter() - t0) / 50)
+                rows.append((best, tile))
+                print(f"  {tag:12s} {knob}={tile}   {best * 1e6:9.1f} us",
+                      flush=True)
+            except Exception as e:
+                print(f"  {tag:12s} {knob}={tile}   FAILED: {str(e)[:100]}",
+                      flush=True)
+        setattr(pa, knob, default)
+        if rows:
+            rows.sort()
+            print(f"  {tag:12s} best {knob}={rows[0][1]} "
+                  f"({rows[0][0] * 1e6:.1f} us; default {default})")
 
 
 if __name__ == "__main__":

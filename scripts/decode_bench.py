@@ -58,13 +58,17 @@ def main():
     p.add_argument("--scenario", default="uniform",
                    choices=("uniform", "long_context", "spec_decode",
                             "shared_prefix", "fused_decode",
-                            "mixed_prefill"))
+                            "mixed_prefill", "tree_spec"))
     p.add_argument("--burst-ns", default="1,4,8",
                    help="fused_decode scenario: comma-separated burst "
                         "lengths (tokens per dispatch) to sweep")
     p.add_argument("--spec-ks", default="2,4,8,12",
                    help="spec_decode scenario: comma-separated draft "
                         "depths to sweep")
+    p.add_argument("--spec-trees", default="2,2,1;3,1,1;2,1,1,1",
+                   help="tree_spec scenario: semicolon-separated tree "
+                        "shapes (comma fan-outs); all must spend the same "
+                        "draft-token budget as the linear chain they race")
     p.add_argument("--slots", type=int, default=4,
                    help="decode slots (long_context: the RING config's "
                         "slot count, which sets the cache memory budget)")
@@ -144,6 +148,8 @@ def main():
         result = _fused_decode(args, vocab)
     elif args.scenario == "mixed_prefill":
         result = _mixed_prefill(args, vocab)
+    elif args.scenario == "tree_spec":
+        result = _tree_spec(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -153,7 +159,8 @@ def main():
                     "spec_decode": "BENCH_decode_spec",
                     "shared_prefix": "BENCH_decode_prefix",
                     "fused_decode": "BENCH_decode_fused",
-                    "mixed_prefill": "BENCH_prefill_packed"}.get(
+                    "mixed_prefill": "BENCH_prefill_packed",
+                    "tree_spec": "BENCH_decode_tree"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -912,6 +919,170 @@ def _mixed_prefill(args, vocab):
         "decode_under_prefill_load_p50_ms": round(lm["decode_p50_ms"], 3),
         "decode_under_prefill_load_p95_ms": round(lm["decode_p95_ms"], 3),
         "decode_under_prefill_load_requests": lm["requests_completed"],
+        "points": points,
+    }
+
+
+def _tree_spec(args, vocab):
+    """Tree vs linear speculation at a FIXED draft-token budget.
+
+    Every speculative point spends the SAME draft budget per round and
+    differs only in how the proposed tokens are arranged: a linear
+    k-chain (plain ``spec_round``) vs branching ``spec_tree`` shapes
+    with the identical node count. The draft is the TARGET's own weights
+    perturbed by ~1% gaussian noise — accepted often, wrong often enough
+    that its argmax chain derails mid-round, which is exactly the regime
+    where a sibling branch rescues the rest of the round instead of
+    forfeiting it.
+
+    The comparison metric is ACCEPTED TOKENS PER VERIFY DISPATCH: each
+    round is ONE verify-program dispatch regardless of shape, so at
+    equal budget this isolates what the tree arrangement buys. Wall
+    clock is recorded but CPU-incidental (the tree verify does more
+    FLOPs per dispatch than the chain's accepted prefix would need — the
+    win is acceptance at fixed dispatch count, which prices in on
+    accelerators where dispatch latency dominates the tiny-S GEMMs).
+
+    The sweep points run the ``chunk`` verify implementation — the real
+    ancestor-masked tree forward, the only one that SCORES siblings (the
+    ``exact`` escape hatch walks just the primary chain, so a tree can
+    never beat its own chain there). Greedy streams of the chunk points
+    are compared to the non-spec baseline and mismatch counts RECORDED,
+    not asserted — the multi-branch forward's bf16 accumulation is
+    shape-dependent (the spec_decode caveat). One extra EXACT-mode tree
+    point carries the bit-exactness contract: its greedy stream is
+    ASSERTED identical to the baseline. Every drain runs the strict
+    block leak guard. The receipt FAILS unless the best tree shape beats
+    the linear chain on accepted/round at equal budget.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine, parse_spec_tree)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    # seq_len=256 for the RoPE table (tiny preset ships 128)
+    cfg = get_config(args.model, vocab_size=vocab, seq_len=256)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    # near-miss draft: the target plus 0.4% noise on every parameter
+    # leaf — accepted ~25% per node, derails mid-round often enough that
+    # siblings rescue ~20% of accepted tokens (the branch-util gauge)
+    eps = 0.004
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(args.seed + 77), len(leaves))
+    draft = jax.tree_util.tree_unflatten(treedef, [
+        l + jnp.asarray(eps, l.dtype)
+        * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)])
+
+    shapes = [parse_spec_tree(s) for s in args.spec_trees.split(";")]
+    budget = shapes[0].size - 1
+    assert all(s.size - 1 == budget for s in shapes), (
+        "--spec-trees shapes must all spend the same draft-token budget")
+
+    slots, prompt_len, gen, bs = 2, 24, 48, 16
+    max_len = prompt_len + gen + bs
+    common = dict(slots=slots, max_len=max_len, prefill_buckets=(16, 32),
+                  kv_layout="paged", kv_block_size=bs)
+    lrng = np.random.default_rng(args.seed + 123)
+    prompts = [lrng.integers(3, vocab, size=prompt_len).tolist()
+               for _ in range(8)]
+    warm_prompts = [lrng.integers(3, vocab, size=prompt_len).tolist()
+                    for _ in range(2)]
+
+    def drive(engine, plist, gen_tokens=gen):
+        sched = Scheduler(engine, eos_token_id=None)
+        for i, pr in enumerate(plist):
+            sched.submit(Request(id=f"r{i}", prompt=list(pr),
+                                 max_new_tokens=gen_tokens))
+        t0 = time.monotonic()
+        out = sched.run()        # strict leak guard runs at this drain
+        m = sched.metrics()
+        m["wall_seconds"] = time.monotonic() - t0
+        return m, {c.request_id: c.tokens for c in out}
+
+    base = InferenceEngine(cfg, params, **common)
+    drive(base, warm_prompts)
+    base.reset()
+    bm, base_streams = drive(base, prompts)
+    base = None
+
+    points = []
+    sweep = ([("linear", None, budget, "chunk")]
+             + [(",".join(str(f) for f in s.fanouts), s, s.depth, "chunk")
+                for s in shapes]
+             + [(",".join(str(f) for f in shapes[0].fanouts), shapes[0],
+                 shapes[0].depth, "exact")])
+    for tag, shape, k, impl in sweep:
+        eng = InferenceEngine(
+            cfg, params, draft_cfg=cfg, draft_params=draft, spec_k=k,
+            spec_tree=None if shape is None else tag,
+            spec_verify_impl=impl, **common)
+        drive(eng, warm_prompts)
+        eng.reset()
+        m, streams = drive(eng, prompts)
+        mismatched = sum(streams[rid] != base_streams[rid]
+                         for rid in base_streams)
+        if impl == "exact":
+            # the escape-hatch contract: primary-chain micro-step verify
+            # shares the decode program's op shapes, so this holds by
+            # construction (tests/test_spec_decode.py pins it too)
+            assert mismatched == 0, (
+                f"exact-impl tree {tag} diverged from greedy baseline "
+                f"in {mismatched} stream(s)")
+        if shape is None:
+            accepted = (m["spec_accepted_tokens"]
+                        / max(m["spec_rounds"], 1))
+        else:
+            accepted = m["spec_accepted_per_round"]
+        points.append({
+            "shape": tag,
+            "verify_impl": impl,
+            "nodes": 1 + budget,
+            "draft_tokens_per_round": budget,
+            "accepted_per_round": round(accepted, 3),
+            "acceptance_rate": round(m["spec_acceptance_rate"], 3),
+            "spec_rounds": m["spec_rounds"],
+            "branch_utilization": (
+                None if shape is None
+                else round(m["spec_tree_branch_utilization"], 3)),
+            "tokens_per_sec": round(m["tokens_per_sec"], 1),
+            "wall_seconds": round(m["wall_seconds"], 3),
+            "bit_match_greedy": mismatched == 0,
+            "mismatched_streams": mismatched,
+            "leak_guard_clean": True,     # strict audit inside run()
+        })
+        eng = None
+
+    linear_pt = points[0]
+    best = max((p for p in points[1:] if p["verify_impl"] == "chunk"),
+               key=lambda p: p["accepted_per_round"])
+    gain = best["accepted_per_round"] / max(linear_pt["accepted_per_round"],
+                                            1e-9)
+    assert gain > 1.0, (
+        f"no tree shape beat the linear {budget}-chain on accepted tokens "
+        f"per verify dispatch (best {best['shape']}: "
+        f"{best['accepted_per_round']} vs {linear_pt['accepted_per_round']})")
+    return {
+        "metric": (f"tree vs linear speculation, accepted tokens per "
+                   f"verify dispatch at a fixed {budget}-draft-token "
+                   f"budget ({args.model}, vocab {vocab}, prompt "
+                   f"{prompt_len}, gen {gen}, {slots} slots, {eps:g} "
+                   f"draft noise, chunk verify, backend "
+                   f"{jax.default_backend()})"),
+        "value": round(gain, 2),
+        "unit": "x linear k-chain accepted/round at equal draft budget",
+        "best_shape": best["shape"],
+        "draft_budget": budget,
+        "draft_noise": eps,
+        "baseline_tokens_per_sec": round(bm["tokens_per_sec"], 1),
         "points": points,
     }
 

@@ -321,6 +321,79 @@ def check_paged_chunk_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64, s_q=8,
     return ok
 
 
+def check_tree_verify_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64,
+                             dtype=jnp.bfloat16):
+    """Ancestor-masked tree-verify: pallas in-place kernel vs the gather
+    reference, compiled on the chip, over the adversarial pool matrix
+    (shuffled tables, window starting ON and STRADDLING block boundaries,
+    stale table tails, an orphan-block entry, shared prefix rows). The
+    tree window is a real TreeShape's flattened rows — the exact (S, S)
+    visibility matrix the engine bakes into its verify programs. Also
+    pins the masked-byte bitwise invariance: rewriting every pool byte
+    outside the committed prefixes + tree windows must not move a bit."""
+    from fault_tolerant_llm_training_tpu.inference.engine import TreeShape
+    from fault_tolerant_llm_training_tpu.ops.attention import (
+        paged_tree_attention,
+    )
+
+    shape = TreeShape((2, 2, 1))
+    s_q = shape.size
+    anc = jnp.asarray(shape.anc_mask)
+    rng = np.random.default_rng(6)
+    n_pool = slots * nb + 4
+    np_k = rng.standard_normal((n_pool, kv, bs, d))
+    np_v = rng.standard_normal((n_pool, kv, bs, d))
+    perm = rng.permutation(np.arange(1, slots * nb + 1))
+    tables = perm.reshape(slots, nb).astype(np.int32)
+    # offsets are committed lengths; tree row j sits at offsets[b] + j
+    offsets = rng.integers(0, nb * bs - s_q, size=slots).astype(np.int32)
+    offsets[0] = 2 * bs                     # window starts ON a boundary
+    offsets[1] = bs - s_q // 2              # window STRADDLES a boundary
+    for b in range(slots):                  # free blocks past the window
+        tables[b, (int(offsets[b]) + s_q - 1) // bs + 1:] = 0
+    tables[2, -1] = n_pool - 1              # stale entry at an orphan block
+    tables[3, :2] = tables[2, :2]           # shared prefix rows
+    q = jnp.asarray(rng.standard_normal((slots, s_q, h, d)), dtype)
+    pool_k, pool_v = jnp.asarray(np_k, dtype), jnp.asarray(np_v, dtype)
+    jtables, joffsets = jnp.asarray(tables), jnp.asarray(offsets)
+
+    def ref(q, k, v, t, o):
+        return paged_tree_attention(q, k, v, t, o, anc, impl="gather")
+
+    def ker(q, k, v, t, o):
+        return paged_tree_attention(q, k, v, t, o, anc, impl="pallas")
+
+    want = jax.jit(ref)(q, pool_k, pool_v, jtables, joffsets)
+    got = jax.jit(ker)(q, pool_k, pool_v, jtables, joffsets)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(want.astype(jnp.float32)))) or 1.0
+
+    live = np.zeros((n_pool, bs), bool)
+    for b in range(slots):
+        for i in range(nb):
+            for lane in range(bs):
+                if i * bs + lane <= int(offsets[b]) + s_q - 1:
+                    live[tables[b, i], lane] = True
+    mask = live[:, None, :, None]
+    k2 = jnp.asarray(np.where(mask, np_k, rng.standard_normal(np_k.shape)),
+                     dtype)
+    v2 = jnp.asarray(np.where(mask, np_v, rng.standard_normal(np_v.shape)),
+                     dtype)
+    got2 = jax.jit(ker)(q, k2, v2, jtables, joffsets)
+    invariant = bool(jnp.array_equal(got, got2))
+
+    ok = err / scale < 2e-2 and invariant
+    print(json.dumps({
+        "check": (f"tree_verify_vs_gather_onchip slots={slots} kv={kv} "
+                  f"h={h} bs={bs} nb={nb} d={d} "
+                  f"shape={','.join(map(str, shape.fanouts))}"),
+        "max_abs_err": err, "rel": err / scale,
+        "masked_bytes_bitwise_invariant": invariant, "ok": ok,
+    }), flush=True)
+    return ok
+
+
 def main():
     ok = True
     ok &= check_flash_parity(2048, 12, 12, 64)   # resident, bench shape
@@ -341,6 +414,8 @@ def main():
     ok &= check_paged_decode_parity(h=8, kv=4, d=128)       # flagship width
     ok &= check_paged_chunk_parity()                        # S>1 chunk, D=64
     ok &= check_paged_chunk_parity(h=8, kv=4, d=128)        # flagship width
+    ok &= check_tree_verify_parity()                        # tree spec, D=64
+    ok &= check_tree_verify_parity(h=8, kv=4, d=128)        # flagship width
     sys.exit(0 if ok else 1)
 
 

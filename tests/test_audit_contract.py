@@ -73,6 +73,9 @@ FROZEN = {
         "Packed prefill | rounds {rounds} | rows {rows} | occupancy "
         "{occupancy:.3f} | inplace chunks {inplace} | gather chunks "
         "{gather}",
+    "AUDIT_SERVE_TREE_SPEC_FMT":
+        "Tree spec | shape {shape} | rounds {rounds} | nodes {nodes} | "
+        "accepted/round {per_round:.2f} | branch util {util:.3f}",
     "AUDIT_KV_LEAK_FMT":
         "[KV LEAK] {pool} pool: {leaked} block(s) leaked after drain "
         "({used} allocated, {cached} prefix-cached)",

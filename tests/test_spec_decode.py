@@ -1,4 +1,4 @@
-"""Speculative decoding (inference/ spec mode): four layers of evidence.
+"""Speculative decoding (inference/ spec mode): five layers of evidence.
 
 1. kernel — ``spec_accept`` degenerates to exact argmax matching for
    greedy rows, and for sampled rows its emitted tokens follow the TARGET
@@ -12,7 +12,12 @@
    non-speculative paged path across chunked prefill and block-pool
    eviction/refill (slow: builds two real engines);
 4. lifecycle — dual-pool admission/rollback/double-free contracts and
-   mid-prompt drain exactness, pinned against a fake spec engine.
+   mid-prompt drain exactness, pinned against a fake spec engine;
+5. tree — multi-branch rejection matches the target law in closed form,
+   scheduler tree rounds refeed/bank/attribute branches correctly and
+   drain leak-free, greedy EXACT-mode tree streams (prefix caches on AND
+   off, draft mirror included) bit-match non-spec decode, and the
+   ``fork_slot`` COW beam primitive honors the allocator contract.
 
 Module scope imports nothing from the package: the collect-only guard at
 the bottom asserts NO test module pays the draft path's import cost (or
@@ -201,12 +206,15 @@ def test_greedy_spec_stream_bitmatches_nonspec_paged():
                            draft_num_blocks=7, **kw)
     got, sched = streams(spec)
     assert got == want
-    # both pools fully drained back to the free lists (the target pool via
-    # a prefix-cache flush: committed prompt blocks stay cache-held after
-    # drain; the draft pool opts out of caching so it must already be free)
+    # both pools fully drained back to the free lists via a prefix-cache
+    # flush each: committed prompt blocks stay cache-held after drain in
+    # BOTH pools now (the draft runs a mirror of the target's radix tree)
     assert sched.allocator.used_count == sched.prefix_cache.cached_blocks
     sched.prefix_cache.flush()
     assert sched.allocator.free_count == sched.allocator.capacity
+    assert (sched.draft_allocator.used_count
+            == sched.draft_prefix_cache.cached_blocks)
+    sched.draft_prefix_cache.flush()
     assert sched.draft_allocator.free_count == sched.draft_allocator.capacity
     m = sched.metrics()
     assert m["spec_rounds"] > 0 and m["spec_draft_tokens"] > 0
@@ -323,7 +331,266 @@ def test_block_allocator_double_free_raises():
         alloc.free(blocks)
 
 
-# ------------------------------------------------- 5. collect-only guard
+# ------------------------------------------------- 5. tree speculation
+def test_tree_accept_multibranch_matches_target_distribution():
+    """Multi-branch rejection on a 3-token vocab, shape (2,): the primary
+    child is sampled from its draft law q, the sibling is a deterministic
+    pick (given the primary) whose honest proposal law is therefore a
+    point mass — exactly the one-hot q row the engine writes for
+    siblings. Every branch trial is a valid rejection-sampling step, so
+    the FIRST emitted token's marginal must be the target p EXACTLY, and
+    the acceptance rate has a closed form strictly above linear
+    speculation's sum(min(p, q)). Checked at ~4 sigma on 8000 rounds.
+
+    Closed form for this construction (p=[.2,.5,.3], q=[.5,.3,.2],
+    sibling = primary+1 mod 3): linear acceptance sum(min(p,q)) = 0.7;
+    only primary 0 can be rejected (mass .5 * .6 = .3), the residual is
+    [0, 2/3, 1/3] and its sibling is token 1 — accepted with prob 2/3 —
+    so tree acceptance = 0.7 + 0.3 * 2/3 = 0.9."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.sampler import tree_accept
+
+    q = jnp.asarray([0.5, 0.3, 0.2], jnp.float32)
+    p = np.array([0.2, 0.5, 0.3], np.float32)
+    child = jnp.asarray([[1, 2], [-1, -1], [-1, -1]], jnp.int32)
+    logits = jnp.log(jnp.asarray(p))[None, :].repeat(3, axis=0)
+    n = 8000
+
+    def one_round(key):
+        kd, ka = jax.random.split(key)
+        t0 = jax.random.categorical(kd, jnp.log(q)).astype(jnp.int32)
+        sib = (t0 + 1) % 3
+        toks = jnp.stack([jnp.int32(0), t0, sib])
+        probs = jnp.stack([q, q, jax.nn.one_hot(sib, 3)])
+        out, path, a = tree_accept(toks, probs, logits, ka,
+                                   jnp.float32(1.0), jnp.float32(1.0),
+                                   child, 1)
+        return out[0], a
+
+    keys = jax.random.split(jax.random.PRNGKey(7), n)
+    toks, acc = jax.jit(jax.vmap(one_round))(keys)
+    toks, acc = np.asarray(toks), np.asarray(acc)
+
+    emp = np.bincount(toks, minlength=3) / n
+    se = np.sqrt(p * (1 - p) / n)
+    np.testing.assert_allclose(emp, p, atol=float((4 * se).max()))
+    expect_accept = 0.9
+    se_a = np.sqrt(expect_accept * (1 - expect_accept) / n)
+    assert abs(acc.mean() - expect_accept) < 4 * se_a
+
+
+def test_tree_round_banking_attributes_branches_and_drains_clean():
+    """Scheduler tree rounds against a host-side double: refeed windows
+    carry exactly the tokens the previous round banked (prefill = round 0
+    with one token), acceptance lands in the spec counters under the tree
+    budget, off-primary path rows feed the branch-utilization gauge, and
+    a mid-stream drain leaves both pools leak-free (strict leak guard
+    runs inside Scheduler.run)."""
+    from fault_tolerant_llm_training_tpu.inference.engine import TreeShape
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+
+    shape = TreeShape((2, 1))
+
+    class _FakeTreeEngine(_FakeSpecEngine):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.spec_tree = shape
+            self._tree_refeed = shape.depth + 1
+            self.seen_refeed = []
+
+        def spec_tree_round(self, refeed, refeed_len, lengths, active,
+                            temperature, top_p, seeds, rounds,
+                            block_tables=None, draft_block_tables=None,
+                            shape=None):
+            s = self.spec_tree
+            for i in range(self.slots):
+                if active[i]:
+                    self.seen_refeed.append(
+                        list(refeed[i, :refeed_len[i]]))
+            out = np.full((self.slots, s.depth + 1), 2, np.int32)
+            acc = np.full((self.slots,), s.depth, np.int32)
+            path = np.zeros((self.slots, s.depth), np.int32)
+            path[:, 0] = s.primary_rows[0] + 1  # accepted SIBLING at L1
+            path[:, 1] = s.primary_rows[1]
+            return out, acc, path
+
+    eng = _FakeTreeEngine(slots=2)
+    sched = Scheduler(eng, eos_token_id=None)
+    sched.submit(Request(id="a", prompt=[1] * 4, max_new_tokens=7))
+    sched.submit(Request(id="b", prompt=[1] * 4, max_new_tokens=5))
+    done = sched.run()
+    assert {c.request_id for c in done} == {"a", "b"}
+    # round 1's refeed is the prefill token alone; every later round
+    # refeeds the 3 tokens (accepted pair + bonus) banked before it
+    assert eng.seen_refeed[:2] == [[1], [1]]
+    assert all(r == [2, 2, 2] for r in eng.seen_refeed[2:])
+    m = sched.metrics()
+    assert m["spec_tree_rounds"] > 0
+    assert m["spec_tree_nodes"] > 0
+    assert m["spec_tree_nodes"] % shape.size == 0
+    # each round accepts one off-primary and one primary node
+    assert m["spec_tree_branch_utilization"] == 0.5
+    assert m["spec_draft_tokens"] % (shape.size - 1) == 0
+    assert sched.allocator.free_count == sched.allocator.capacity
+    assert sched.draft_allocator.free_count == sched.draft_allocator.capacity
+
+    # mid-stream drain: stop after the first tree round — active slots
+    # finish, the queued request is reported unserved, leak guard clean
+    eng2 = _FakeTreeEngine(slots=1)
+    sched2 = Scheduler(eng2, eos_token_id=None)
+    for i in range(3):
+        sched2.submit(Request(id=f"r{i}", prompt=[1] * 4, max_new_tokens=9))
+    sched2.run(stop=lambda: sched2.iterations >= 1)  # strict guard inside
+    assert len(sched2.unserved()) >= 1
+    assert not sched2.admission_open
+    assert sched2.allocator.free_count == sched2.allocator.capacity
+    assert (sched2.draft_allocator.free_count
+            == sched2.draft_allocator.capacity)
+
+
+@pytest.mark.slow
+def test_greedy_tree_spec_stream_bitmatches_nonspec_paged():
+    """Tree tentpole end to end: greedy EXACT-mode tree streams are
+    BIT-identical to non-speculative paged decode across chunked prefill
+    and block-pool eviction/refill, cache-on AND cache-off — the repeated
+    prompt additionally pins the satellite contract that prefix-cache
+    hits (including the DRAFT-pool mirror's) leave spec streams
+    unchanged. The draft is independently initialized, so exactness must
+    come from the verify/commit path, not draft quality."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine, enable_compilation_cache)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    enable_compilation_cache(CACHE)
+    cfg = get_config("tiny", vocab_size=64, seq_len=64)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    draft_params = Transformer(cfg).init(
+        jax.random.PRNGKey(9),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+
+    rng = np.random.default_rng(5)
+    shared = rng.integers(3, 64, size=20).tolist()
+    reqs = [(shared, 10), (shared, 8)]  # adjacent duplicates: cache hits
+    for n in (9, 36, 13, 5):            # 36 exceeds the 16 bucket: chunked
+        reqs.append((rng.integers(3, 64, size=n).tolist(), 10))
+    kw = dict(slots=2, max_len=48, prefill_buckets=(16,), kv_layout="paged",
+              kv_block_size=16, kv_num_blocks=7)  # 6 usable: evict/refill
+
+    def streams(engine):
+        sched = Scheduler(engine, eos_token_id=None)
+        for i, (prompt, gen) in enumerate(reqs):
+            sched.submit(Request(id=f"r{i}", prompt=prompt,
+                                 max_new_tokens=gen))
+        done = sched.run()
+        assert len(done) == len(reqs)
+        return {c.request_id: c.tokens for c in done}, sched
+
+    base = InferenceEngine(cfg, params, **kw)
+    want, _ = streams(base)
+    del base
+
+    spec_kw = dict(draft_cfg=cfg, draft_params=draft_params, spec_k=3,
+                   spec_tree="2,1,1", draft_num_blocks=7)
+    tree = InferenceEngine(cfg, params, **spec_kw, **kw)
+    got, sched = streams(tree)
+    assert got == want
+    m = sched.metrics()
+    assert m["spec_tree_rounds"] > 0 and m["spec_tree_nodes"] > 0
+    # the adjacent duplicate prompt hit BOTH radix trees: the draft
+    # mirror absorbed at least its one fully-committed block
+    assert m["draft_prefix_hit_tokens"] >= 16
+    assert m["prefix_hit_tokens"] >= 16
+    del tree
+
+    off = InferenceEngine(cfg, params, prefix_cache=False, **spec_kw, **kw)
+    got_off, sched_off = streams(off)
+    assert got_off == want
+    assert "draft_prefix_hit_rate" not in sched_off.metrics()
+
+
+@pytest.mark.slow
+def test_fork_slot_cow_beam_contract():
+    """COW beam fork over the paged substrate: ``engine.fork_slot``
+    aliases full shared blocks (refcount 2 — the prefix cache's sharing
+    contract), duplicates only the partial boundary block into a fresh
+    allocation, both beams decode independently afterwards, and each row
+    frees through the uniform allocator path exactly once — the second
+    free of the same row raises. Exhaustion acquires nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine, enable_compilation_cache)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        BlockAllocator)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+
+    enable_compilation_cache(CACHE)
+    cfg = get_config("tiny", vocab_size=64, seq_len=64)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, cfg.seq_len), jnp.int32)
+    )["params"]
+    eng = InferenceEngine(cfg, params, slots=2, max_len=32,
+                          prefill_buckets=(16,), kv_layout="paged",
+                          kv_block_size=8, prefix_cache=False)
+    alloc = BlockAllocator(eng.num_blocks)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(3, 64, size=12).tolist()  # 1.5 blocks committed
+    src_blocks = alloc.alloc(3)
+    src_row = np.zeros((eng.max_blocks_per_slot,), np.int32)
+    src_row[:3] = src_blocks
+    first = eng.prefill(0, prompt, block_row=src_row, seed=1)
+
+    dst_row = eng.fork_slot(0, 1, length=12, src_row=src_row,
+                            allocator=alloc)
+    assert dst_row is not None
+    # full block aliased (refcount 2), boundary block freshly private
+    assert dst_row[0] == src_row[0] and alloc.refcount(src_row[0]) == 2
+    assert dst_row[1] != src_row[1] and alloc.refcount(dst_row[1]) == 1
+    assert int(np.asarray(eng.cache.lengths)[1]) == 12
+
+    # both beams decode through their own tables (shared prefix read-only)
+    tables = np.stack([src_row, dst_row])
+    toks = np.array([first, first], np.int32)
+    for i in range(3):
+        toks = eng.decode_step(
+            toks, np.array([True, True]),
+            np.array([0.9, 0.9], np.float32), np.ones(2, np.float32),
+            np.array([1, 2], np.int32),
+            np.full(2, 12 + i, np.int32), block_tables=tables)
+
+    # exhaustion acquires nothing: drain the pool, then fork at a
+    # non-aligned length must return None without touching refcounts
+    rest = alloc.alloc(alloc.free_count)
+    used_before = alloc.used_count
+    assert eng.fork_slot(0, 1, length=12, src_row=src_row,
+                         allocator=alloc) is None
+    assert alloc.used_count == used_before
+    alloc.free(rest)
+
+    # uniform free path: each row exactly once; the second free raises
+    dst_blocks = [int(b) for b in dst_row[:2]]
+    alloc.free(dst_blocks)
+    assert alloc.refcount(src_row[0]) == 1
+    alloc.free(src_blocks)
+    assert alloc.free_count == alloc.capacity
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free(dst_blocks)
+
+
+# ------------------------------------------------- 6. collect-only guard
 def test_no_test_module_imports_inference_at_module_scope():
     """Collecting the test suite must not import the inference package
     (and with it jax program-building code): every test imports it inside
