@@ -10,8 +10,9 @@ inject -> die -> resume -> verify scenarios end-to-end and writes a
 survival report from the flight-recorder trail.
 """
 
-from .schedule import ChaosEntry, FAULTS, SERVE_FAULTS, parse_schedule
+from .schedule import (ChaosEntry, FAULTS, FLEET_FAULTS, SERVE_FAULTS,
+                       parse_schedule)
 from .injector import ChaosInjector
 
-__all__ = ["ChaosEntry", "ChaosInjector", "FAULTS", "SERVE_FAULTS",
-           "parse_schedule"]
+__all__ = ["ChaosEntry", "ChaosInjector", "FAULTS", "FLEET_FAULTS",
+           "SERVE_FAULTS", "parse_schedule"]

@@ -205,6 +205,33 @@ class ChaosInjector:
             self._fire(e, at_step=iteration, signum=int(signum), serve=True)
             ft_signals.inject(signum)
 
+    def on_fleet_step(self, iteration: int) -> None:
+        """Fleet-host loop hook (inference/fleet.py), keyed by the host's
+        loop iteration: the drain signals work as in ``on_serve_step``, and
+        ``host_kill`` SIGKILLs this host mid-decode — no handler, no drain,
+        no journal flush beyond what already committed. ``_fire`` runs
+        first, so the chaos audit line and its flight-recorder event are
+        on disk before the process dies; everything after is the router's
+        problem (dead verdict -> migrate), which is the point."""
+        for e in self._pending(("sigusr1", "sigterm"), iteration):
+            signum = (_signal.SIGUSR1 if e.fault == "sigusr1"
+                      else _signal.SIGTERM)
+            self._fire(e, at_step=iteration, signum=int(signum), fleet=True)
+            ft_signals.inject(signum)
+        for e in self._pending(("host_kill",), iteration):
+            self._fire(e, at_step=iteration,
+                       signum=int(_signal.SIGKILL), fleet=True)
+            os.kill(os.getpid(), _signal.SIGKILL)
+
+    def on_heartbeat(self, iteration: int) -> None:
+        """Lease-renewal hook (inference/fleet.py), keyed by loop
+        iteration: ``heartbeat_delay`` sleeps before the renewal write, so
+        the router's sweep sees a stale lease on a live host — shorter
+        than the ttl it must ride through, longer it must self-fence."""
+        for e in self._pending(("heartbeat_delay",), iteration):
+            self._fire(e, at_step=iteration, seconds=e.arg)
+            time.sleep(e.arg or 0.0)
+
     def on_publish(self, step_dir: str, step: int, log) -> Optional[str]:
         """Publisher hook (deploy/publish.py), called AFTER the
         ``published.json`` pointer commit: ``publish_corrupt`` flips one

@@ -54,6 +54,14 @@ reload_signal   deliver a real SIGUSR1 in the middle of a hot weight swap
                 (deploy/reload.py), keyed by reload ordinal (1 = first
                 reload) — the swap must complete and the drain then run
                 on the NEW weights
+host_kill       SIGKILL this serving-fleet host mid-decode (keyed by fleet
+                loop iteration) — no handler runs, no drain: the router's
+                lease sweep must declare it dead and migrate its in-flight
+                requests onto survivors (inference/fleet.py)
+heartbeat_delay sleep inside the fleet host's lease-renewal path (arg =
+                duration, default 2s) — a slow-but-alive host: shorter
+                than the ttl it must NOT trip the dead verdict; longer, it
+                must self-fence rather than double-commit
 ==============  ============================================================
 
 Steps are *global* training steps, so an entry in the past at resume time
@@ -78,12 +86,19 @@ FAULTS = {
     "kv_fail": None,
     "publish_corrupt": None,
     "reload_signal": None,
+    "host_kill": None,
+    "heartbeat_delay": 2.0,
 }
 
 # The serving loop has no training steps, prefetcher or KV agreement: only
 # the signal faults (a mid-decode drain) and the mid-swap reload signal
 # make sense there.
 SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal")
+
+# A fleet host adds the membership faults; "one rank" is expressed by
+# giving only that host's process the entry (each host is a separate OS
+# process with its own schedule, so @rank= is unnecessary there).
+FLEET_FAULTS = ("sigusr1", "sigterm", "host_kill", "heartbeat_delay")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ENTRY_RE = re.compile(

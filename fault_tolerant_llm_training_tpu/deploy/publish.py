@@ -85,19 +85,30 @@ def write_pointer(root: str, ptr: Pointer) -> str:
     return final
 
 
+def read_pointer_strict(root: str) -> Optional[Pointer]:
+    """Read ``published.json``: a genuinely ABSENT pointer is None, but a
+    torn, unreadable or wrong-shaped one RAISES — the distinction the
+    watcher's bounded retry (ft/retry.py) needs to tell "nothing published
+    yet" from "transient I/O trouble worth retrying"."""
+    try:
+        with open(pointer_path(root)) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return None
+    return Pointer(step=int(data["step"]), job_id=str(data["job_id"]),
+                   path=str(data["path"]),
+                   manifest_digest=str(data["manifest_digest"]),
+                   draft=data.get("draft"),
+                   version=int(data.get("version", 1)))
+
+
 def read_pointer(root: str) -> Optional[Pointer]:
     """Read ``published.json`` tolerantly: a missing, torn, or
     wrong-shaped pointer reads as None (the watcher just polls again) —
     the atomic write makes torn reads near-impossible, but a reader must
     never crash the serving process over a pointer file."""
     try:
-        with open(pointer_path(root)) as fh:
-            data = json.load(fh)
-        return Pointer(step=int(data["step"]), job_id=str(data["job_id"]),
-                       path=str(data["path"]),
-                       manifest_digest=str(data["manifest_digest"]),
-                       draft=data.get("draft"),
-                       version=int(data.get("version", 1)))
+        return read_pointer_strict(root)
     except (OSError, ValueError, KeyError, TypeError):
         return None
 

@@ -39,6 +39,7 @@ import os
 import time
 from typing import Optional
 
+from ..ft.retry import RetryDeadlineExceeded, retry_with_backoff
 from ..obs import events
 from ..obs.registry import REGISTRY
 from ..utils.logging import (
@@ -46,7 +47,7 @@ from ..utils.logging import (
     AUDIT_RELOAD_REJECTED_FMT,
     logger,
 )
-from .publish import Pointer, read_pointer, verify_pointer
+from .publish import Pointer, read_pointer_strict, verify_pointer
 
 _M_RELOADS = REGISTRY.counter(
     "ftl_weights_reload_total",
@@ -69,14 +70,34 @@ class PointerWatcher:
     the same step with a rewritten manifest is a NEW offer, while a
     rejected publish is not re-verified on every poll — the trainer must
     publish something new to be considered again.
+
+    Transient pointer-read failures (a slow or flapping filesystem, a
+    mid-replace window) are retried with a bounded deadline
+    (ft/retry.py, the same policy as the fleet lease path): on expiry the
+    poll renders a clean "no pointer this poll" verdict — a dead
+    coordinator costs at most ``deadline_seconds`` per poll, never a hang
+    and never a crashed serving process.
     """
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, deadline_seconds: float = 1.0,
+                 clock=time.monotonic, sleep=time.sleep):
         self.root = os.path.abspath(root)
+        self.deadline = float(deadline_seconds)
+        self.clock = clock
+        self.sleep = sleep
         self._seen = None
 
     def poll(self) -> Optional[Pointer]:
-        ptr = read_pointer(self.root)
+        try:
+            ptr = retry_with_backoff(
+                lambda: read_pointer_strict(self.root),
+                deadline_seconds=self.deadline,
+                retry_on=(OSError, ValueError, KeyError, TypeError),
+                clock=self.clock, sleep=self.sleep,
+                what="published.json read")
+        except RetryDeadlineExceeded as e:
+            logger.warning(f"[DEPLOY] pointer poll gave up: {e}")
+            return None
         if ptr is None:
             return None
         key = (ptr.job_id, ptr.step, ptr.manifest_digest)

@@ -1,0 +1,252 @@
+"""Fleet membership: heartbeat leases over a crash-safe file KV store.
+
+The pod fault fence (ft/multihost.py) taught the pattern: liveness is a
+lease the holder must keep renewing, death is a *verdict* rendered by a
+peer from lease age, and a fence (tombstone) makes the verdict sticky so
+a zombie that wakes up late cannot double-commit. This module ports that
+pattern to the serving fleet, with two deliberate differences:
+
+- the substrate is a plain directory (:class:`FileKVStore`, atomic
+  tmp+rename writes) rather than the jax.distributed client, so fleet
+  hosts are ordinary OS processes and NO process is load-bearing — the
+  store survives any participant being SIGKILLed mid-write;
+- freshness is carried in the lease VALUE (the holder stamps wall time at
+  each renewal), not in filesystem mtime, so the verdict logic is pure
+  data and testable with a fake clock.
+
+Every store op in the lease path goes through
+:func:`ft.retry.retry_with_backoff` with a bounded deadline: a dead or
+wedged store yields a failed renewal / a raised deadline — a clean
+verdict — never a hang.
+
+Split-brain safety contract (enforced across router.py and fleet.py):
+the router writes the tombstone BEFORE journaling any migration, and a
+host treats EITHER a tombstone on itself OR ``ttl`` elapsed since its own
+last successful renewal (monotonic clock) as a self-fence — it abandons
+its in-flight work without another journal write. A host that cannot
+prove its lease is live can therefore never race a migrated replica.
+"""
+
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .retry import RetryDeadlineExceeded, retry_with_backoff
+
+__all__ = ["FileKVStore", "HostLease", "LeaseRegistry"]
+
+LEASE_PREFIX = "fleet/lease"
+TOMBSTONE_PREFIX = "fleet/dead"
+
+
+class FileKVStore:
+    """Directory-backed KV store with atomic, torn-write-proof updates.
+
+    Keys are slash-separated paths (``fleet/lease/host_0``); values are
+    strings. ``set`` writes a temp file in the destination directory and
+    ``os.replace``s it into place, so readers see either the old value or
+    the new one, never a partial write — the same finalize discipline as
+    checkpoint publishing."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p]
+        if not parts or any(p == ".." for p in parts):
+            raise ValueError(f"bad KV key: {key!r}")
+        return os.path.join(self.root, *parts)
+
+    def set(self, key: str, value: str) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".kv_tmp_")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(value)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str) -> Dict[str, str]:
+        """All key -> value pairs directly under ``prefix``."""
+        base = self._path(prefix)
+        out: Dict[str, str] = {}
+        try:
+            names = sorted(os.listdir(base))
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if name.startswith(".kv_tmp_"):
+                continue
+            val = self.get(f"{prefix}/{name}")
+            if val is not None:
+                out[name] = val
+        return out
+
+
+@dataclass
+class HostLease:
+    """One host's decoded lease record plus its age at read time."""
+    host_id: str
+    t: float                 # wall time stamped by the holder at renewal
+    ttl: float
+    slots_free: int
+    blocks_free: int
+    block_size: int
+    pid: int
+    age: float               # reader's now - t
+
+    @property
+    def live(self) -> bool:
+        return self.age <= self.ttl
+
+
+class LeaseRegistry:
+    """Register/renew/read heartbeat leases with capacity metadata.
+
+    One instance per participant. Hosts call :meth:`renew` every loop
+    iteration (publishing free slot/block counts the router routes by);
+    the router calls :meth:`leases` each sweep and renders dead verdicts
+    from lease age. All store traffic is retried with a bounded deadline.
+    """
+
+    def __init__(self, store: FileKVStore, host_id: Optional[str] = None,
+                 ttl_seconds: float = 2.0, deadline_seconds: float = 1.0,
+                 clock: Callable[[], float] = time.time,
+                 monotonic: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.store = store
+        self.host_id = host_id
+        self.ttl = float(ttl_seconds)
+        self.deadline = float(deadline_seconds)
+        self.clock = clock
+        self.monotonic = monotonic
+        self.sleep = sleep
+        self._last_renew_mono: Optional[float] = None
+
+    def _retry(self, fn, what: str):
+        return retry_with_backoff(fn, deadline_seconds=self.deadline,
+                                  clock=self.monotonic, sleep=self.sleep,
+                                  retry_on=(OSError,), what=what)
+
+    # ------------------------------------------------------------- holder side
+    def renew(self, slots_free: int, blocks_free: int,
+              block_size: int) -> bool:
+        """Stamp a fresh lease; returns False on a bounded-deadline failure
+        (the caller counts a failed renewal toward its self-fence)."""
+        if self.host_id is None:
+            raise ValueError("renew() requires a host_id")
+        value = json.dumps({
+            "t": self.clock(), "ttl": self.ttl,
+            "slots_free": int(slots_free), "blocks_free": int(blocks_free),
+            "block_size": int(block_size), "pid": os.getpid(),
+        })
+        try:
+            self._retry(
+                lambda: self.store.set(f"{LEASE_PREFIX}/{self.host_id}", value),
+                what=f"lease renew {self.host_id}")
+        except RetryDeadlineExceeded:
+            return False
+        self._last_renew_mono = self.monotonic()
+        return True
+
+    register = renew  # first renewal IS registration; no separate handshake
+
+    def leave(self) -> None:
+        if self.host_id is None:
+            raise ValueError("leave() requires a host_id")
+        try:
+            self._retry(
+                lambda: self.store.delete(f"{LEASE_PREFIX}/{self.host_id}"),
+                what=f"lease leave {self.host_id}")
+        except RetryDeadlineExceeded:
+            pass  # expired leases read as dead anyway; leave is best-effort
+
+    def fenced(self) -> bool:
+        """Self-fence check for the holder: True once this host can no
+        longer prove its own lease is live — either a peer tombstoned it,
+        or ``ttl`` elapsed (monotonic) since its last successful renewal.
+        After True the host must not journal further progress."""
+        if self.host_id is None:
+            raise ValueError("fenced() requires a host_id")
+        if self._last_renew_mono is not None and (
+                self.monotonic() - self._last_renew_mono) > self.ttl:
+            return True
+        try:
+            return self.is_tombstoned(self.host_id)
+        except RetryDeadlineExceeded:
+            return True  # can't disprove the fence -> fence
+
+    # ------------------------------------------------------------- reader side
+    def leases(self, now: Optional[float] = None) -> Dict[str, HostLease]:
+        now = self.clock() if now is None else now
+        raw = self._retry(lambda: self.store.list(LEASE_PREFIX),
+                          what="lease sweep")
+        out: Dict[str, HostLease] = {}
+        for host, val in raw.items():
+            try:
+                d = json.loads(val)
+                out[host] = HostLease(
+                    host_id=host, t=float(d["t"]), ttl=float(d["ttl"]),
+                    slots_free=int(d.get("slots_free", 0)),
+                    blocks_free=int(d.get("blocks_free", 0)),
+                    block_size=int(d.get("block_size", 1)),
+                    pid=int(d.get("pid", 0)),
+                    age=max(0.0, now - float(d["t"])))
+            except (ValueError, KeyError, TypeError):
+                continue  # torn/garbage lease reads as absent, not as a crash
+        return out
+
+    def live(self, now: Optional[float] = None) -> List[str]:
+        tombs = self.tombstones()
+        return [h for h, l in sorted(self.leases(now).items())
+                if l.live and h not in tombs]
+
+    def dead(self, now: Optional[float] = None) -> List[str]:
+        """Hosts holding a lease that is expired or tombstoned."""
+        tombs = self.tombstones()
+        return [h for h, l in sorted(self.leases(now).items())
+                if not l.live or h in tombs]
+
+    def tombstone(self, host_id: str) -> None:
+        """Fence a dead host. MUST be written before any migration record
+        for that host's requests is journaled (see module docstring)."""
+        value = json.dumps({"t": self.clock(), "by": self.host_id or "router"})
+        self._retry(
+            lambda: self.store.set(f"{TOMBSTONE_PREFIX}/{host_id}", value),
+            what=f"tombstone {host_id}")
+
+    def is_tombstoned(self, host_id: str) -> bool:
+        return self._retry(
+            lambda: self.store.get(f"{TOMBSTONE_PREFIX}/{host_id}"),
+            what=f"tombstone check {host_id}") is not None
+
+    def tombstones(self) -> List[str]:
+        return sorted(self._retry(
+            lambda: self.store.list(TOMBSTONE_PREFIX),
+            what="tombstone sweep").keys())

@@ -1,0 +1,82 @@
+"""Bounded retry-with-backoff for transient coordinator/KV-store failures.
+
+The lease/membership path (ft/lease.py) and the deploy pointer watcher
+(deploy/reload.py) both poll shared state that can fail transiently — a
+slow NFS rename, a pointer file mid-replace, a KV-store op hitting a
+restarting coordinator. The failure policy is the same everywhere and is
+deliberately *bounded*: retry with exponential backoff against a single
+monotonic deadline, then raise :class:`RetryDeadlineExceeded` so the
+caller renders a clean verdict (stale lease, no pointer this poll, failed
+renewal) instead of hanging on a dead coordinator forever.
+
+Clock and sleep are injectable so tests drive the deadline without
+wall-clock waits, mirroring the fake-clock idiom in the pod fault fence.
+"""
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["RetryDeadlineExceeded", "retry_with_backoff"]
+
+
+class RetryDeadlineExceeded(RuntimeError):
+    """The bounded deadline elapsed without a successful attempt.
+
+    ``last_error`` carries the final attempt's exception (``None`` only if
+    the deadline was already spent before the first attempt could run)."""
+
+    def __init__(self, what: str, deadline_seconds: float, attempts: int,
+                 last_error: Optional[BaseException]):
+        self.what = what
+        self.deadline_seconds = deadline_seconds
+        self.attempts = attempts
+        self.last_error = last_error
+        detail = f": {last_error!r}" if last_error is not None else ""
+        super().__init__(
+            f"{what} failed for {deadline_seconds:.1f}s "
+            f"({attempts} attempt(s)){detail}")
+
+
+def retry_with_backoff(
+    fn: Callable,
+    *,
+    deadline_seconds: float,
+    base_delay: float = 0.05,
+    max_delay: float = 1.0,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    what: str = "kv-store op",
+):
+    """Call ``fn()`` until it succeeds or the deadline elapses.
+
+    One deadline bounds the WHOLE call (the gather_stops pattern from the
+    pod fence), not each attempt — so a dead coordinator costs at most
+    ``deadline_seconds`` before the caller gets its verdict. Backoff
+    doubles from ``base_delay`` up to ``max_delay`` and is clipped to the
+    time remaining, so the final sleep never overshoots the deadline.
+    """
+    if deadline_seconds <= 0:
+        raise ValueError(f"deadline_seconds must be > 0, got {deadline_seconds}")
+    deadline = clock() + deadline_seconds
+    delay = base_delay
+    attempts = 0
+    last_error: Optional[BaseException] = None
+    while True:
+        if clock() >= deadline:
+            raise RetryDeadlineExceeded(what, deadline_seconds, attempts,
+                                        last_error)
+        attempts += 1
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last_error = e
+            if on_retry is not None:
+                on_retry(attempts, e)
+            remaining = deadline - clock()
+            if remaining <= 0:
+                raise RetryDeadlineExceeded(what, deadline_seconds, attempts,
+                                            last_error)
+            sleep(min(delay, remaining))
+            delay = min(delay * 2.0, max_delay)

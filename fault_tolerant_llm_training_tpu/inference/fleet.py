@@ -1,0 +1,364 @@
+"""Fleet serving host: one member of a multi-host serving fleet.
+
+``python -m fault_tolerant_llm_training_tpu.inference.fleet`` runs ONE
+engine+scheduler process that (a) registers a heartbeat lease with
+capacity metadata in the shared KV store (ft/lease.py) and renews it
+every loop iteration, (b) tails the router's journal file
+(inference/journal.py) for ``assign``/``migrate`` records addressed to
+it and submits them to the continuous-batching scheduler, and (c)
+journals its own ``progress`` records (the FULL committed token list) at
+every decode-round boundary plus a ``done`` record per completion — the
+replayable trail the router migrates from when this host dies.
+
+Migrated requests arrive with a non-empty ``committed`` baseline: the
+scheduler replays ``prompt + committed[:-1]`` as the prefill (cheap under
+the prefix cache), seeds the slot with the committed stream, and the
+``fold_in(seed, step)`` PRNG makes the continuation bit-identical to the
+stream the dead host would have produced (scheduler.py `_Slot`).
+
+Death and fencing (the split-brain contract, ft/lease.py docstring):
+
+- A SIGKILL (chaos ``host_kill``) leaves no handler, no drain — the
+  lease simply stops renewing, the router's sweep renders the dead
+  verdict and tombstones BEFORE migrating.
+- The host self-fences when it cannot prove its own lease live
+  (tombstoned, or ttl elapsed since its last successful renewal): it
+  exits WITHOUT another journal write, so a zombie that stalled past its
+  ttl (chaos ``heartbeat_delay`` > ttl) can never double-commit against
+  the migrated replica.
+- A signal drain (SIGUSR1/SIGTERM) finishes in-flight requests, then
+  persists anything still queued as ``requeue`` records and runs the
+  KV-block leak guard — the campaign pins "Fleet drain leak guard:
+  clean" on every survivor.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ..chaos import FLEET_FAULTS, ChaosInjector, parse_schedule
+from ..data.tokenizer import load_tokenizer
+from ..ft.lease import FileKVStore, LeaseRegistry
+from ..ft.signals import SignalFlag
+from ..models.configs import get_config
+from ..obs import events
+from ..obs.prometheus import MetricsServer
+from ..utils.logging import (
+    AUDIT_FLEET_JOIN_FMT,
+    AUDIT_FLEET_LEAVE_FMT,
+    AUDIT_REQUEST_DONE_FMT,
+    AUDIT_SERVE_DRAINING_FMT,
+    AUDIT_SERVE_READY_FMT,
+    init_logger,
+    logger,
+)
+from .engine import (
+    DEFAULT_COMPILE_CACHE_DIR,
+    InferenceEngine,
+    enable_compilation_cache,
+)
+from .journal import RequestJournal, persist_unserved
+from .scheduler import Request, Scheduler
+
+ROUTER_JOURNAL = "router.jsonl"
+
+
+class _AssignmentFollower:
+    """Tail ``router.jsonl`` for assign/migrate records addressed to this
+    host. Byte-offset tracking, complete (newline-terminated) lines only —
+    the same torn-read discipline as serve.py's request follower."""
+
+    def __init__(self, journal_dir: str, host_id: str):
+        self.path = os.path.join(journal_dir, ROUTER_JOURNAL)
+        self.host_id = host_id
+        self.offset = 0
+
+    def poll(self):
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size <= self.offset:
+            return []
+        with open(self.path, "rb") as fh:
+            fh.seek(self.offset)
+            data = fh.read()
+        end = data.rfind(b"\n")
+        if end < 0:
+            return []
+        chunk = data[:end + 1]
+        self.offset += len(chunk)
+        out = []
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("kind") in ("assign", "migrate")
+                    and rec.get("host") == self.host_id):
+                out.append(rec)
+        return out
+
+
+def get_fleet_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="fault_tolerant_llm_training_tpu.inference.fleet",
+        description="One serving-fleet host: heartbeat lease + journal-"
+                    "driven request intake with migration replay.")
+    p.add_argument("--host-id", required=True,
+                   help="this host's fleet identity (lease + journal key)")
+    p.add_argument("--store", required=True,
+                   help="shared KV-store directory (leases + tombstones)")
+    p.add_argument("--journal-dir", required=True,
+                   help="shared request-journal directory")
+    p.add_argument("--lease-ttl", type=float, default=2.0,
+                   help="heartbeat lease ttl in seconds: miss renewals for "
+                        "longer and the router declares this host dead")
+    p.add_argument("--kv-deadline", type=float, default=1.0,
+                   help="bounded retry deadline per KV-store operation")
+    p.add_argument("--checkpoint-path", required=True)
+    p.add_argument("--checkpoint-job-id", required=True)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--vocab-size", type=int, default=0)
+    p.add_argument("--tokenizer-name-or-path", default="byte")
+    p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--max-len", type=int, default=0)
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--kv-num-blocks", type=int, default=0)
+    p.add_argument("--paged-kernel", default="gather",
+                   choices=("gather", "pallas"))
+    p.add_argument("--compile-cache-dir", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-eos", action="store_true")
+    p.add_argument("--log-frequency", type=int, default=8)
+    p.add_argument("--poll-seconds", type=float, default=0.05,
+                   help="idle sleep between loop iterations with no work")
+    p.add_argument("--max-run-seconds", type=float, default=0.0,
+                   help="safety timeout: drain and exit after this long "
+                        "(0 = run until signaled)")
+    p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--event-log", default="")
+    p.add_argument("--chaos", default="",
+                   help="fault schedule: host_kill / sigusr1 / sigterm "
+                        "keyed by decode iteration (serve.py convention); "
+                        "heartbeat_delay keyed by fleet loop iteration")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = get_fleet_args(argv)
+    init_logger()
+    flag = SignalFlag()
+    flag.register()
+    chaos = None
+    if args.chaos:
+        chaos = ChaosInjector(
+            parse_schedule(args.chaos, allowed=FLEET_FAULTS),
+            seed=args.seed)
+        logger.info(f"Chaos schedule | {chaos.describe()}")
+    if args.event_log:
+        events.configure(args.event_log, job=f"fleet_{args.host_id}",
+                         host=os.getpid())
+    metrics_server = None
+    if args.metrics_port:
+        metrics_server = MetricsServer(port=args.metrics_port)
+        port = metrics_server.start()
+        logger.info(f"Metrics | serving /metrics on port {port}")
+
+    with flag.deferred():  # block delivery across compile + Orbax restore
+        cache_dir = (DEFAULT_COMPILE_CACHE_DIR
+                     if args.compile_cache_dir is None
+                     else args.compile_cache_dir)
+        if enable_compilation_cache(cache_dir):
+            logger.info(f"Compilation cache | {cache_dir}")
+        tokenizer = load_tokenizer(args.tokenizer_name_or_path)
+        vocab = args.vocab_size or tokenizer.vocab_size
+        cfg = get_config(args.model, vocab_size=vocab,
+                         layer_impl=args.layer_impl)
+        engine = InferenceEngine.from_checkpoint(
+            args.checkpoint_path, args.checkpoint_job_id, cfg,
+            step=args.step, slots=args.slots,
+            max_len=args.max_len or None, kv_layout="paged",
+            kv_block_size=args.kv_block_size,
+            kv_num_blocks=args.kv_num_blocks or None,
+            paged_kernel=args.paged_kernel)
+        events.emit_audit(
+            logger, AUDIT_SERVE_READY_FMT.format(
+                model=args.model, step=engine.restored_step,
+                slots=args.slots),
+            "ready", step=engine.restored_step, slots=args.slots,
+            model=args.model)
+        sched = Scheduler(engine,
+                          eos_token_id=(None if args.no_eos
+                                        else tokenizer.eos_token_id),
+                          stop_check=lambda: flag.signum is not None)
+
+    store = FileKVStore(args.store)
+    lease = LeaseRegistry(store, host_id=args.host_id,
+                          ttl_seconds=args.lease_ttl,
+                          deadline_seconds=args.kv_deadline)
+    journal = RequestJournal(args.journal_dir,
+                             writer=f"host_{args.host_id}")
+    follower = _AssignmentFollower(args.journal_dir, args.host_id)
+
+    def capacity():
+        slots_free = max(0, engine.slots - len(sched.active)
+                         - len(sched._pending_prefill) - len(sched.queue))
+        blocks_free = (sched.allocator.free_count
+                       if sched.kv_layout == "paged" else 0)
+        return slots_free, blocks_free, getattr(engine, "block_size", 1)
+
+    slots_free, blocks_free, block_size = capacity()
+    lease.register(slots_free, blocks_free, block_size)
+    events.emit_audit(
+        logger, AUDIT_FLEET_JOIN_FMT.format(
+            host=args.host_id, slots=slots_free, blocks=blocks_free,
+            ttl=lease.ttl),
+        "fleet_join", host=args.host_id, slots=slots_free,
+        blocks=blocks_free, ttl=lease.ttl)
+    events.flush()
+
+    gens = {}     # rid -> generation of my current/last assignment
+    done_ids = set()
+    n_done = 0    # consumed prefix of sched.completed
+    it = 0
+    t0 = time.monotonic()
+    exit_reason = None  # None = keep serving; else drain with this reason
+
+    def emit_completions():
+        nonlocal n_done
+        for c in sched.completed[n_done:]:
+            gen = gens.get(c.request_id, 0)
+            journal.done(c.request_id, args.host_id, c.tokens, c.reason,
+                         gen=gen)
+            done_ids.add(c.request_id)
+            decoded = (c.tokens[:-1]
+                       if (not args.no_eos and c.reason == "eos")
+                       else c.tokens)
+            events.emit_audit(
+                logger, AUDIT_REQUEST_DONE_FMT.format(
+                    id=c.request_id, reason=c.reason,
+                    prompt_tokens=c.prompt_len, new_tokens=len(c.tokens),
+                    ttft_ms=c.ttft_seconds * 1e3,
+                    tps=c.decode_tokens_per_sec),
+                "request_done", id=c.request_id, reason=c.reason,
+                tokens=len(c.tokens), gen=gen, host=args.host_id)
+            logger.info("Request %s output: %r", c.request_id,
+                        tokenizer.decode(decoded))
+        n_done = len(sched.completed)
+
+    while exit_reason is None:
+        it += 1
+        if chaos is not None:
+            chaos.on_heartbeat(it)  # heartbeat_delay: a slow-but-alive host
+        slots_free, blocks_free, block_size = capacity()
+        renewed = lease.renew(slots_free, blocks_free, block_size)
+        if not renewed or lease.fenced():
+            # self-fence: this host can no longer prove its lease live —
+            # a migrated replica may already be running, so NO further
+            # journal writes (split-brain contract, ft/lease.py)
+            events.emit_audit(
+                logger, AUDIT_FLEET_LEAVE_FMT.format(
+                    host=args.host_id, reason="fenced"),
+                "fleet_leave", host=args.host_id, reason="fenced")
+            events.flush()
+            if metrics_server is not None:
+                metrics_server.stop()
+            sys.exit(0)
+
+        for rec in follower.poll():
+            rid = str(rec["id"])
+            gen = int(rec.get("gen", 0))
+            if rid in done_ids or gens.get(rid, -1) >= gen:
+                continue  # stale or duplicate assignment
+            gens[rid] = gen
+            committed = [int(t) for t in rec.get("committed") or []]
+            try:
+                sched.submit(Request(
+                    id=rid,
+                    prompt=[int(t) for t in rec.get("prompt", [])],
+                    max_new_tokens=int(rec.get("max_new_tokens", 32)),
+                    temperature=float(rec.get("temperature", 0.0)),
+                    top_p=float(rec.get("top_p", 1.0)),
+                    seed=int(rec.get("seed", 0)),
+                    committed=tuple(committed)))
+            except ValueError as e:
+                logger.warning(f"[FLEET] rejecting assignment {rid}: {e}")
+
+        if flag.signum is not None:
+            exit_reason = "drain"
+            break
+        if args.max_run_seconds and (time.monotonic() - t0
+                                     > args.max_run_seconds):
+            logger.warning("[FLEET] max-run-seconds reached; draining")
+            exit_reason = "timeout"
+            break
+
+        if sched.pending():
+            if chaos is not None:
+                # host_kill lands here, keyed by decode iteration like
+                # serve.py's on_serve_step: SIGKILL mid-decode, no
+                # handler, no drain — the router's lease sweep takes it
+                # from there. Progress through this round is already
+                # journaled, so the migration replays a committed prefix.
+                chaos.on_fleet_step(sched.iterations)
+            sched.step()
+            emit_completions()
+            # decode-round boundary: journal the FULL committed stream of
+            # every active slot — the baseline a migration replays from
+            for st in sched.active.values():
+                journal.progress(st.request.id, args.host_id, st.tokens,
+                                 gen=gens.get(st.request.id, 0))
+            if sched.iterations % args.log_frequency == 0:
+                logger.info(
+                    "Fleet host %s | iter %d | active %d | queued %d | "
+                    "done %d", args.host_id, sched.iterations,
+                    len(sched.active), len(sched.queue),
+                    len(sched.completed))
+        else:
+            time.sleep(args.poll_seconds)
+
+    # ---- signal / timeout drain: finish in-flight, requeue the rest ----
+    events.emit_audit(
+        logger, AUDIT_SERVE_DRAINING_FMT.format(
+            signum=flag.signum or 0, active=len(sched.active)),
+        "drain", phase="begin", signum=flag.signum,
+        active=len(sched.active))
+    sched.stop_admission()
+    while sched.active or sched._pending_prefill:
+        sched.step()
+        emit_completions()
+        for st in sched.active.values():
+            journal.progress(st.request.id, args.host_id, st.tokens,
+                             gen=gens.get(st.request.id, 0))
+    emit_completions()
+    persist_unserved(journal, sched.unserved(), reason=exit_reason,
+                     gens=gens)
+    leaks = sched.audit_block_leaks(strict=False)
+    if not leaks:
+        logger.info("Fleet drain leak guard: clean")
+    else:
+        logger.warning("Fleet drain leak guard: %d violation(s)",
+                       len(leaks))
+    events.emit_audit(
+        logger, AUDIT_FLEET_LEAVE_FMT.format(
+            host=args.host_id, reason=exit_reason),
+        "fleet_leave", host=args.host_id, reason=exit_reason)
+    lease.leave()
+    events.flush()
+    if metrics_server is not None:
+        metrics_server.stop()
+    # exit 0 always — the exit POLICY is in the logs, same contract as
+    # serve.py and training
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

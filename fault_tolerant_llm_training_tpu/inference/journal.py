@@ -1,0 +1,222 @@
+"""Crash-durable request journal: the fleet's replayable source of truth.
+
+A journal is a DIRECTORY of per-writer JSONL files (``router.jsonl``,
+``host_h0.jsonl``, ``serve_1234.jsonl``): each participant appends only to
+its own file, so concurrent writers never interleave bytes and a SIGKILL
+mid-append can at worst truncate the killer's own last line (torn tails
+are skipped at read time). Every append is fsynced — a record that was
+journaled survives any process death, which is the property the zero-
+lost-requests guarantee stands on.
+
+Record kinds:
+
+- ``assign``   router -> host: request parameters + target host, gen 0.
+- ``progress`` host: the FULL committed token list at a decode-round
+  boundary (full, not delta — any single record reconstructs the stream).
+- ``done``     host: final tokens + finish reason.
+- ``migrate``  router: re-admission of a dead host's request on a
+  survivor at gen+1; self-contained (carries params + committed baseline)
+  so hosts only ever need to tail ``router.jsonl``.
+- ``requeue``  a draining host persists requests it will not finish
+  (queued, mid-prefill, or in-flight) for later re-admission — the same
+  record serves single-host ``serve.py --journal-dir`` drains and fleet
+  drains, unifying both on one code path.
+
+:func:`fold` reduces all files to per-request state. Resolution leans on
+the fleet's determinism contract: committed lists written for the same
+request at different generations are prefixes of ONE deterministic stream
+(``fold_in(seed, step)`` PRNG + bit-exact replay), so the longest list
+wins and any prefix mismatch is corruption worth raising on.
+"""
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["RequestJournal", "RequestState", "fold", "persist_unserved"]
+
+
+@dataclass
+class RequestState:
+    """Folded view of one request across every journal file."""
+    request_id: str
+    prompt: List[int] = field(default_factory=list)
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+    gen: int = 0                   # current assignment generation
+    host: Optional[str] = None     # current owner (None after requeue)
+    committed: List[int] = field(default_factory=list)
+    done: bool = False
+    done_tokens: List[int] = field(default_factory=list)
+    reason: str = ""
+    migrations: int = 0
+    requeued: bool = False         # latest ownership record is a requeue
+
+
+class RequestJournal:
+    """One participant's append handle on a journal directory."""
+
+    def __init__(self, root: str, writer: str):
+        if "/" in writer or writer.startswith("."):
+            raise ValueError(f"bad journal writer name: {writer!r}")
+        self.root = root
+        self.writer = writer
+        os.makedirs(root, exist_ok=True)
+        self.path = os.path.join(root, f"{writer}.jsonl")
+
+    def _append(self, rec: Dict) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        # open/append/fsync/close per record: slow-path simple, and the
+        # journal must survive the writer being SIGKILLed at any byte.
+        with open(self.path, "a") as fh:
+            fh.write(line)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    # ------------------------------------------------------------ record kinds
+    def assign(self, request_id: str, host: str, prompt: List[int],
+               max_new_tokens: int, temperature: float, top_p: float,
+               seed: int) -> None:
+        self._append({"kind": "assign", "id": request_id, "host": host,
+                      "prompt": [int(t) for t in prompt],
+                      "max_new_tokens": int(max_new_tokens),
+                      "temperature": float(temperature),
+                      "top_p": float(top_p), "seed": int(seed), "gen": 0})
+
+    def progress(self, request_id: str, host: str, committed: List[int],
+                 gen: int) -> None:
+        self._append({"kind": "progress", "id": request_id, "host": host,
+                      "committed": [int(t) for t in committed],
+                      "gen": int(gen)})
+
+    def done(self, request_id: str, host: str, tokens: List[int],
+             reason: str, gen: int) -> None:
+        self._append({"kind": "done", "id": request_id, "host": host,
+                      "tokens": [int(t) for t in tokens],
+                      "reason": reason, "gen": int(gen)})
+
+    def migrate(self, request_id: str, src: str, dst: str, gen: int,
+                prompt: List[int], max_new_tokens: int, temperature: float,
+                top_p: float, seed: int, committed: List[int]) -> None:
+        self._append({"kind": "migrate", "id": request_id, "src": src,
+                      "host": dst, "gen": int(gen),
+                      "prompt": [int(t) for t in prompt],
+                      "max_new_tokens": int(max_new_tokens),
+                      "temperature": float(temperature),
+                      "top_p": float(top_p), "seed": int(seed),
+                      "committed": [int(t) for t in committed]})
+
+    def requeue(self, request_id: str, prompt: List[int],
+                max_new_tokens: int, temperature: float, top_p: float,
+                seed: int, committed: List[int], gen: int,
+                host: Optional[str] = None) -> None:
+        self._append({"kind": "requeue", "id": request_id, "host": host,
+                      "prompt": [int(t) for t in prompt],
+                      "max_new_tokens": int(max_new_tokens),
+                      "temperature": float(temperature),
+                      "top_p": float(top_p), "seed": int(seed),
+                      "committed": [int(t) for t in committed],
+                      "gen": int(gen)})
+
+
+def persist_unserved(journal: "RequestJournal", requests, reason: str,
+                     gens: Optional[Dict[str, int]] = None) -> int:
+    """Drain-time persistence shared by ``serve.py --journal-dir`` and the
+    fleet host: every request the drain will not finish becomes ONE
+    self-contained ``requeue`` record (params + committed baseline) the
+    router can re-admit later. The requeue is written at gen+1 of the
+    request's current assignment so it outranks the old ``assign`` in
+    :func:`fold` regardless of file read order. Returns the count."""
+    from ..obs import events
+    from ..utils.logging import AUDIT_FLEET_REQUEUE_FMT, logger
+
+    n = 0
+    for req in requests:
+        committed = [int(t) for t in getattr(req, "committed", ()) or ()]
+        gen = int((gens or {}).get(req.id, 0)) + 1
+        journal.requeue(req.id, list(req.prompt), req.max_new_tokens,
+                        req.temperature, req.top_p, req.seed, committed,
+                        gen=gen)
+        events.emit_audit(
+            logger, AUDIT_FLEET_REQUEUE_FMT.format(
+                id=req.id, committed=len(committed), reason=reason),
+            "fleet_requeue", id=req.id, committed=len(committed),
+            reason=reason, gen=gen)
+        n += 1
+    return n
+
+
+def _read_records(root: str) -> List[Dict]:
+    recs: List[Dict] = []
+    try:
+        names = sorted(os.listdir(root))
+    except FileNotFoundError:
+        return recs
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(root, name)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail of a SIGKILLed writer
+    return recs
+
+
+def _is_prefix(a: List[int], b: List[int]) -> bool:
+    return len(a) <= len(b) and b[: len(a)] == a
+
+
+def fold(root: str) -> Dict[str, RequestState]:
+    """Reduce every journal file under ``root`` to per-request state.
+
+    Ownership (host/gen) comes from the highest-generation
+    assign/migrate/requeue record; the committed list is the longest seen
+    anywhere (all are prefixes of the same deterministic stream — verified,
+    a mismatch raises); a ``done`` record wins outright, highest gen
+    preferred when a fenced host double-reported."""
+    states: Dict[str, RequestState] = {}
+    for rec in _read_records(root):
+        rid = rec.get("id")
+        if not rid:
+            continue
+        st = states.get(rid)
+        if st is None:
+            st = states[rid] = RequestState(request_id=rid)
+        kind = rec.get("kind")
+        gen = int(rec.get("gen", 0))
+        if kind in ("assign", "migrate", "requeue"):
+            if gen >= st.gen:
+                st.gen = gen
+                st.host = rec.get("host")
+                st.requeued = kind == "requeue"
+            if kind == "migrate":
+                st.migrations += 1
+            st.prompt = [int(t) for t in rec.get("prompt", st.prompt)]
+            st.max_new_tokens = int(rec.get("max_new_tokens",
+                                            st.max_new_tokens))
+            st.temperature = float(rec.get("temperature", st.temperature))
+            st.top_p = float(rec.get("top_p", st.top_p))
+            st.seed = int(rec.get("seed", st.seed))
+        committed = rec.get("committed") if kind != "done" else rec.get("tokens")
+        if committed is not None:
+            committed = [int(t) for t in committed]
+            short, long_ = sorted([st.committed, committed], key=len)
+            if not _is_prefix(short, long_):
+                raise ValueError(
+                    f"journal divergence for {rid}: committed lists "
+                    f"{st.committed} and {committed} are not prefixes of "
+                    f"one stream — determinism contract violated")
+            st.committed = long_
+        if kind == "done" and (not st.done or gen >= st.gen):
+            st.done = True
+            st.done_tokens = [int(t) for t in rec.get("tokens", [])]
+            st.reason = rec.get("reason", "")
+    return states

@@ -1,0 +1,393 @@
+"""Fleet router: admission, lease sweeps, dead verdicts and migration.
+
+``python -m fault_tolerant_llm_training_tpu.inference.router`` is the
+fleet's control plane — deliberately NOT load-bearing for the data path:
+hosts decode from the journal, the router only appends ``assign`` /
+``migrate`` records to its own file, so a router crash stalls NEW
+admissions but never running requests, and a restarted router recovers
+its entire state from :func:`journal.fold` (it keeps no private truth).
+
+Responsibilities, once per loop:
+
+1. tail the intake JSONL (text prompts) and queue new requests;
+2. sweep heartbeat leases (ft/lease.py): a lease older than its own ttl
+   is a DEAD VERDICT — the router tombstones the host FIRST (fencing any
+   zombie), then folds the journal and re-admits every in-flight request
+   of the dead host on a survivor via a ``migrate`` record at gen+1
+   carrying the committed token baseline (prompt + committed replay on
+   the survivor continues the stream bit-exactly — scheduler.py);
+3. adopt ``requeue`` records that draining hosts (or a single-host
+   ``serve.py --journal-dir`` drain) persisted;
+4. assign queued requests to the live host with the most estimated free
+   KV blocks (lease capacity metadata, decremented locally per
+   assignment so a burst between heartbeats doesn't dogpile one host —
+   over-assignment is safe anyway: the scheduler queues on block
+   exhaustion).
+
+Exactly-once: the router is the ONLY writer of assign/migrate records,
+a dead host is swept once (tombstone + ``handled`` latch), and fold
+resolves ownership by highest generation — a second sweep of the same
+host finds every request already owned by a survivor and migrates
+nothing.
+
+/metrics (when --metrics-port is set): ``fleet_hosts_live``,
+``requests_migrated_total``, ``fleet_lease_age_seconds{host=...}``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..data.tokenizer import load_tokenizer
+from ..ft.lease import FileKVStore, LeaseRegistry
+from ..obs import events
+from ..obs.prometheus import MetricsServer
+from ..obs.registry import REGISTRY
+from ..utils.logging import (
+    AUDIT_FLEET_DEAD_FMT,
+    AUDIT_FLEET_MIGRATE_FMT,
+    init_logger,
+    logger,
+)
+from .journal import RequestJournal, RequestState, fold
+
+_M_HOSTS_LIVE = REGISTRY.gauge(
+    "fleet_hosts_live",
+    "Serving-fleet hosts holding a live, untombstoned lease")
+_M_MIGRATED = REGISTRY.counter(
+    "requests_migrated_total",
+    "Requests re-admitted on a survivor after a dead verdict or requeue")
+_M_LEASE_AGE = REGISTRY.gauge(
+    "fleet_lease_age_seconds",
+    "Age of each fleet host's heartbeat lease at the last router sweep")
+
+
+class Router:
+    """Journal-driven fleet control plane (module docstring). Pure state
+    machine over (store, journal) — the CLI below just loops it."""
+
+    def __init__(self, store: FileKVStore, journal_dir: str,
+                 deadline_seconds: float = 1.0, clock=time.time):
+        self.lease = LeaseRegistry(store, host_id=None,
+                                   deadline_seconds=deadline_seconds,
+                                   clock=clock)
+        self.journal = RequestJournal(journal_dir, writer="router")
+        self.journal_dir = journal_dir
+        self.clock = clock
+        self.pending: deque = deque()  # dicts awaiting a host
+        self.pending_ids = set()
+        self.assigned: Dict[str, tuple] = {}  # rid -> (host, gen) I wrote
+        self.handled_dead = set()
+        self.migrated_total = 0
+        # per-host capacity estimate, reset whenever the host stamps a
+        # fresh lease, decremented locally per assignment in between
+        self.est: Dict[str, dict] = {}
+
+    # ---------------------------------------------------------------- intake
+    def submit(self, request_id: str, prompt, max_new_tokens: int,
+               temperature: float, top_p: float, seed: int) -> bool:
+        if request_id in self.pending_ids or request_id in self.assigned:
+            return False
+        self.pending.append({
+            "id": request_id, "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature), "top_p": float(top_p),
+            "seed": int(seed), "committed": [], "gen": 0, "src": None})
+        self.pending_ids.add(request_id)
+        return True
+
+    # ------------------------------------------------------------- membership
+    def refresh(self, now: Optional[float] = None):
+        """One lease sweep: returns (leases, tombstones, live) and updates
+        the capacity estimates + membership gauges."""
+        leases = self.lease.leases(now)
+        tombs = set(self.lease.tombstones())
+        live = {h: l for h, l in leases.items()
+                if l.live and h not in tombs}
+        for h, l in live.items():
+            e = self.est.get(h)
+            if e is None or e["stamp"] != l.t:
+                self.est[h] = {"stamp": l.t, "slots": l.slots_free,
+                               "blocks": l.blocks_free,
+                               "block_size": max(1, l.block_size)}
+        for h in list(self.est):
+            if h not in live:
+                del self.est[h]
+        _M_HOSTS_LIVE.set(len(live))
+        for h, l in leases.items():
+            _M_LEASE_AGE.labels(host=h).set(l.age)
+        return leases, tombs, live
+
+    def _blocks_needed(self, item: dict, block_size: int) -> int:
+        n = len(item["prompt"]) + item["max_new_tokens"]
+        return -(-n // max(1, block_size))
+
+    def pick_host(self, item: dict) -> Optional[str]:
+        """Admission policy: the live host with the most estimated free
+        blocks, hosts with a free slot preferred. Returns None when no
+        live host exists (the request waits in ``pending``)."""
+        best = None
+        for h in sorted(self.est):
+            e = self.est[h]
+            key = (e["slots"] > 0, e["blocks"])
+            if best is None or key > best[0]:
+                best = (key, h)
+        return best[1] if best else None
+
+    def _charge(self, host: str, item: dict) -> None:
+        e = self.est.get(host)
+        if e is None:
+            return
+        e["slots"] = max(0, e["slots"] - 1)
+        e["blocks"] = max(
+            0, e["blocks"] - self._blocks_needed(item, e["block_size"]))
+
+    # -------------------------------------------------------------- migration
+    def _item_from_state(self, st: RequestState, src: str) -> dict:
+        return {"id": st.request_id, "prompt": list(st.prompt),
+                "max_new_tokens": st.max_new_tokens,
+                "temperature": st.temperature, "top_p": st.top_p,
+                "seed": st.seed, "committed": list(st.committed),
+                "gen": st.gen, "src": src}
+
+    def _admit(self, item: dict, dst: str) -> None:
+        """Journal one admission: a fresh ``assign`` at gen 0, or a
+        ``migrate`` at gen+1 for anything carrying history."""
+        rid = item["id"]
+        if item["gen"] == 0 and item["src"] is None:
+            self.journal.assign(rid, dst, item["prompt"],
+                                item["max_new_tokens"], item["temperature"],
+                                item["top_p"], item["seed"])
+            self.assigned[rid] = (dst, 0)
+        else:
+            gen = item["gen"] + 1
+            self.journal.migrate(rid, item["src"], dst, gen,
+                                 item["prompt"], item["max_new_tokens"],
+                                 item["temperature"], item["top_p"],
+                                 item["seed"], item["committed"])
+            self.assigned[rid] = (dst, gen)
+            self.migrated_total += 1
+            _M_MIGRATED.inc()
+            events.emit_audit(
+                logger, AUDIT_FLEET_MIGRATE_FMT.format(
+                    id=rid, src=item["src"], dst=dst, gen=gen,
+                    committed=len(item["committed"])),
+                "fleet_migrate", id=rid, src=item["src"], dst=dst,
+                gen=gen, committed=len(item["committed"]))
+        self._charge(dst, item)
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Render dead verdicts and migrate the victims' in-flight
+        requests. Returns how many requests were queued for migration."""
+        leases, tombs, live = self.refresh(now)
+        moved = 0
+        for h in sorted(leases):
+            l = leases[h]
+            if h in self.handled_dead or (l.live and h not in tombs):
+                continue
+            # fence FIRST: after the tombstone a zombie that wakes up
+            # late self-fences instead of double-committing (ft/lease.py)
+            self.lease.tombstone(h)
+            states = fold(self.journal_dir)
+            inflight = sorted(
+                (st for st in states.values()
+                 if st.host == h and not st.done),
+                key=lambda st: st.request_id)
+            events.emit_audit(
+                logger, AUDIT_FLEET_DEAD_FMT.format(
+                    host=h, age=l.age, ttl=l.ttl, inflight=len(inflight)),
+                "fleet_dead", host=h, age=l.age, ttl=l.ttl,
+                inflight=len(inflight))
+            for st in inflight:
+                if len(st.committed) >= st.max_new_tokens:
+                    # the journal already holds the full stream — nothing
+                    # to decode; the router completes it in place
+                    self.journal.done(st.request_id, "router",
+                                      st.committed, "length",
+                                      gen=st.gen + 1)
+                    continue
+                item = self._item_from_state(st, src=h)
+                if st.request_id not in self.pending_ids:
+                    self.pending.append(item)
+                    self.pending_ids.add(st.request_id)
+                    moved += 1
+            self.handled_dead.add(h)
+        return moved
+
+    def adopt_requeued(self) -> int:
+        """Queue ``requeue`` records from draining hosts/servers for
+        re-admission (idempotent across loops via the assigned map)."""
+        n = 0
+        for st in fold(self.journal_dir).values():
+            if st.done or not st.requeued:
+                continue
+            if st.request_id in self.pending_ids:
+                continue
+            a = self.assigned.get(st.request_id)
+            if a is not None and a[1] >= st.gen:
+                continue  # my later (re-)admission already outranks it
+            self.pending.append(
+                self._item_from_state(st, src=st.host or "requeue"))
+            self.pending_ids.add(st.request_id)
+            n += 1
+        return n
+
+    def assign_pending(self) -> int:
+        """Hand queued requests to hosts; stops when no live host is
+        available (they stay queued for the next loop)."""
+        n = 0
+        while self.pending:
+            dst = self.pick_host(self.pending[0])
+            if dst is None:
+                break
+            item = self.pending.popleft()
+            self.pending_ids.discard(item["id"])
+            self._admit(item, dst)
+            n += 1
+        return n
+
+    # -------------------------------------------------------------- liveness
+    def status(self, expected: int):
+        """(done_count, total_known, all_done): the zero-lost check is
+        ``all_done`` — every request the journal has ever seen is done."""
+        states = fold(self.journal_dir)
+        done = sum(1 for st in states.values() if st.done)
+        total = len(states) + len(self.pending)
+        all_done = (not self.pending and total >= expected
+                    and all(st.done for st in states.values()))
+        return done, total, all_done
+
+
+class _IntakeFollower:
+    """Tail the intake JSONL for new requests (text prompts); the same
+    complete-lines-only byte-offset discipline as serve.py."""
+
+    def __init__(self, path: str, tokenizer, args):
+        self.path = path
+        self.tokenizer = tokenizer
+        self.args = args
+        self.offset = 0
+        self.count = 0
+
+    def ingest(self, router: Router) -> int:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0
+        if size <= self.offset:
+            return 0
+        with open(self.path, "rb") as fh:
+            fh.seek(self.offset)
+            data = fh.read()
+        end = data.rfind(b"\n")
+        if end < 0:
+            return 0
+        chunk = data[:end + 1]
+        self.offset += len(chunk)
+        n = 0
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+                prompt = self.tokenizer.encode(str(d["prompt"]))
+            except (ValueError, KeyError, TypeError):
+                logger.warning(f"[ROUTER] skipping malformed intake line "
+                               f"{line!r}")
+                continue
+            rid = str(d.get("id", f"req{self.count}"))
+            self.count += 1
+            if router.submit(
+                    rid, prompt,
+                    int(d.get("max_new_tokens", self.args.max_new_tokens)),
+                    float(d.get("temperature", self.args.temperature)),
+                    float(d.get("top_p", self.args.top_p)),
+                    int(d.get("seed", self.args.seed + self.count))):
+                n += 1
+        return n
+
+
+def get_router_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="fault_tolerant_llm_training_tpu.inference.router",
+        description="Fleet router: admit intake requests to lease-live "
+                    "hosts and migrate in-flight work off dead ones.")
+    p.add_argument("--store", required=True,
+                   help="shared KV-store directory (leases + tombstones)")
+    p.add_argument("--journal-dir", required=True,
+                   help="shared request-journal directory")
+    p.add_argument("--intake", required=True,
+                   help="JSONL file tailed for requests "
+                        "({'id','prompt',...} per line, text prompts)")
+    p.add_argument("--expected", type=int, required=True,
+                   help="exit once this many requests have been ingested "
+                        "AND every journaled request is done")
+    p.add_argument("--kv-deadline", type=float, default=1.0,
+                   help="bounded retry deadline per KV-store operation")
+    p.add_argument("--tokenizer-name-or-path", default="byte")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--poll-seconds", type=float, default=0.1)
+    p.add_argument("--max-seconds", type=float, default=300.0,
+                   help="safety timeout: exit 1 if the fleet has not "
+                        "finished by then")
+    p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--event-log", default="")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = get_router_args(argv)
+    init_logger()
+    if args.event_log:
+        events.configure(args.event_log, job="router", host=os.getpid())
+    metrics_server = None
+    if args.metrics_port:
+        metrics_server = MetricsServer(port=args.metrics_port)
+        port = metrics_server.start()
+        logger.info(f"Metrics | serving /metrics on port {port}")
+    tokenizer = load_tokenizer(args.tokenizer_name_or_path)
+    store = FileKVStore(args.store)
+    router = Router(store, args.journal_dir,
+                    deadline_seconds=args.kv_deadline)
+    follower = _IntakeFollower(args.intake, tokenizer, args)
+    logger.info("Fleet router | store=%s journal=%s expecting %d "
+                "request(s)", args.store, args.journal_dir, args.expected)
+
+    t0 = time.monotonic()
+    rc = 0
+    while True:
+        follower.ingest(router)
+        router.sweep()
+        router.adopt_requeued()
+        router.assign_pending()
+        done, total, all_done = router.status(args.expected)
+        if all_done and follower.count >= args.expected:
+            break
+        if time.monotonic() - t0 > args.max_seconds:
+            logger.error(
+                "[ROUTER] timed out: %d/%d done, %d pending", done, total,
+                len(router.pending))
+            rc = 1
+            break
+        time.sleep(args.poll_seconds)
+
+    done, total, _ = router.status(args.expected)
+    lost = total - done
+    logger.info("Fleet router complete: %d request(s) done, %d migrated, "
+                "%d lost", done, router.migrated_total, lost)
+    events.flush()
+    if metrics_server is not None:
+        metrics_server.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,6 +187,15 @@ class Request:
     temperature: float = 0.0       # <= 0 -> greedy
     top_p: float = 1.0
     seed: int = 0
+    # Migrated-replay prefix (fleet journal): tokens a previous owner
+    # already committed for this request. When non-empty, admission
+    # prefills prompt + committed[:-1] (re-deriving the KV the dead host
+    # held — a prefix-cache hit makes this cheap), banks the committed
+    # list as already-generated output, and resumes decode at step
+    # len(committed) so the fold_in(seed, step) PRNG continues the SAME
+    # stream the original host was producing. committed counts toward
+    # max_new_tokens; an empty tuple is a normal fresh request.
+    committed: Sequence[int] = ()
 
 
 @dataclasses.dataclass
@@ -240,20 +249,32 @@ class _PendingPrefill:
     blocks: List[int]       # every block to free exactly once on abort
     start_pos: int          # prefix-resume offset (0 = no cache hit)
     pos: int                # next absolute position to prefill
+    eff: Sequence[int]      # effective prefill prompt (replay appends the
+                            # committed prefix; == request.prompt otherwise)
 
 
 class _Slot:
     def __init__(self, request: Request, first_token: int,
                  submitted_at: float, now: float):
         self.request = request
-        self.tokens = [first_token]
-        self.steps = 1  # decode-step counter; prefill consumed step 0
+        committed = list(getattr(request, "committed", ()) or ())
+        if committed:
+            # Migrated replay: the committed prefix is already-generated
+            # output (banked here, not re-emitted), and the replay prefill's
+            # sampled token was discarded by the caller — the next decode
+            # feeds committed[-1] and folds (seed, len(committed)), the
+            # exact step the previous owner would have run next.
+            self.tokens = committed
+            self.steps = len(committed)
+        else:
+            self.tokens = [first_token]
+            self.steps = 1  # decode-step counter; prefill consumed step 0
         self.submitted_at = submitted_at
         self.first_token_at = now
         # tree-spec refeed window: the tokens banked by the LAST round
         # (prefill counts as round 0 with just the first token) — the
         # next tree round rewrites their draft KV before proposing
-        self.emitted = [first_token]
+        self.emitted = [self.tokens[-1]]
         # spec-mode per-request accounting (see Completion)
         self.spec_proposed = 0
         self.spec_accepted = 0
@@ -514,10 +535,47 @@ class Scheduler:
     # --- queue management --------------------------------------------------
 
     def _blocks_needed(self, request: Request) -> int:
+        # replay-invariant: committed tokens live inside the same
+        # prompt + max_new_tokens budget the original admission sized
         bs = self.engine.block_size
         return -(-(len(request.prompt) + request.max_new_tokens) // bs)
 
+    @staticmethod
+    def _effective_prompt(request: Request) -> Sequence[int]:
+        """What prefill actually processes: a migrated replay re-derives
+        the dead host's KV by prefilling the prompt PLUS all but the last
+        committed token (the last one is the next decode's input, exactly
+        where the original stream stood)."""
+        committed = list(getattr(request, "committed", ()) or ())
+        if committed:
+            return list(request.prompt) + committed[:-1]
+        return request.prompt
+
+    def _check_replay(self, request: Request, first) -> None:
+        """Replay-integrity check. The replay prefill re-samples a token
+        from the last committed position's logits; that sample is
+        discarded (the committed list is the truth), but where sampling
+        is PRNG-free (greedy) or the fold index coincides (a 1-token
+        replay re-folds (seed, 0) exactly as the original prefill did) it
+        must BIT-MATCH the journaled token — a mismatch means the journal
+        and the model disagree and the migration must not proceed."""
+        committed = list(request.committed)
+        if first is None or not committed:
+            return
+        if request.temperature <= 0 or len(committed) == 1:
+            if int(first) != int(committed[-1]):
+                raise RuntimeError(
+                    f"request {request.id}: replay re-derived token "
+                    f"{int(first)} but the journal committed "
+                    f"{int(committed[-1])} — journal/model divergence")
+
     def submit(self, request: Request) -> None:
+        committed = list(getattr(request, "committed", ()) or ())
+        if committed and len(committed) >= request.max_new_tokens:
+            raise ValueError(
+                f"request {request.id}: {len(committed)} committed tokens "
+                f"already meet max_new_tokens {request.max_new_tokens} — "
+                f"nothing to decode; the caller should record it done")
         if len(request.prompt) + request.max_new_tokens > self.engine.max_len:
             raise ValueError(
                 f"request {request.id}: prompt {len(request.prompt)} + "
@@ -606,6 +664,9 @@ class Scheduler:
         free = [s for s in range(self.engine.slots) if s not in taken]
         while free and self.queue:
             req, submitted_at = self.queue[0]
+            # replay admissions prefill prompt + committed[:-1]; every
+            # prefix-cache and prefill path below works on this view
+            eff = self._effective_prompt(req)
             blocks, dblocks = None, None
             hit, dhit = None, None
             if self.kv_layout == "paged":
@@ -623,7 +684,7 @@ class Scheduler:
                 # a draft-pool shortage can't strand target blocks.
                 total = self._blocks_needed(req)
                 if self.prefix_cache is not None:
-                    hit = self.prefix_cache.match(req.prompt)
+                    hit = self.prefix_cache.match(eff)
                     if not hit.blocks:
                         hit = None
                 fresh = total - (len(hit.blocks) if hit else 0) \
@@ -647,7 +708,7 @@ class Scheduler:
                     # skipped outright (module docstring). A shortage here
                     # rolls back every reference both pools acquired.
                     if self.draft_prefix_cache is not None:
-                        dhit = self.draft_prefix_cache.match(req.prompt)
+                        dhit = self.draft_prefix_cache.match(eff)
                         if not dhit.blocks:
                             dhit = None
                     dfresh = total - (len(dhit.blocks) if dhit else 0)
@@ -704,7 +765,7 @@ class Scheduler:
                     self._pending_prefill.append(_PendingPrefill(
                         request=req, submitted_at=submitted_at, slot=slot,
                         row=row, blocks=slot_blocks, start_pos=start_pos,
-                        pos=start_pos))
+                        pos=start_pos, eff=eff))
                     continue
                 spec_kw = {}
                 slot_dblocks = dblocks
@@ -733,7 +794,7 @@ class Scheduler:
                     spec_kw["start_pos"] = start_pos
                 t0 = self.clock()
                 first = self.engine.prefill(
-                    slot, req.prompt, block_row=row,
+                    slot, eff, block_row=row,
                     temperature=req.temperature, top_p=req.top_p,
                     seed=req.seed, stop_check=self._drain_requested,
                     on_chunk=self._count_chunk, **spec_kw)
@@ -758,28 +819,31 @@ class Scheduler:
                 if self.spec_k:
                     self._slot_draft_blocks[slot] = slot_dblocks
                     if self.draft_prefix_cache is not None:
-                        self.draft_prefix_cache.insert(req.prompt,
-                                                       slot_dblocks)
+                        self.draft_prefix_cache.insert(eff, slot_dblocks)
                         self.draft_prefix_cache.note_admission(
-                            draft_start, len(req.prompt))
+                            draft_start, len(eff))
                 if self.prefix_cache is not None:
-                    self.prefix_cache.insert(req.prompt, slot_blocks)
-                    self.prefix_cache.note_admission(start_pos,
-                                                     len(req.prompt))
+                    self.prefix_cache.insert(eff, slot_blocks)
+                    self.prefix_cache.note_admission(start_pos, len(eff))
                     self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
             else:
                 t0 = self.clock()
-                first = self.engine.prefill(slot, req.prompt,
+                first = self.engine.prefill(slot, eff,
                                             temperature=req.temperature,
                                             top_p=req.top_p, seed=req.seed)
                 self.prefill_seconds += self.clock() - t0
-            self.active[slot] = _Slot(req, first, submitted_at, self.clock())
+            self._check_replay(req, first)
+            st = self.active[slot] = _Slot(req, first, submitted_at,
+                                           self.clock())
             self.max_concurrent = max(self.max_concurrent, len(self.active))
             self._m_tokens.inc()  # the prefill's first token
-            # a request can finish straight out of prefill
-            if self.eos_token_id is not None and first == self.eos_token_id:
+            # a request can finish straight out of prefill (a replay can
+            # arrive with EOS as its last committed token, or within one
+            # token of its budget — the same checks, on the banked tail)
+            if (self.eos_token_id is not None
+                    and st.tokens[-1] == self.eos_token_id):
                 self._finish(slot, "eos", done)
-            elif req.max_new_tokens <= 1:
+            elif len(st.tokens) >= req.max_new_tokens:
                 self._finish(slot, "length", done)
 
     def _abort_pending_prefill(self) -> None:
@@ -804,18 +868,18 @@ class Scheduler:
         returns, including the straight-out-of-prefill finish checks)."""
         self._slot_blocks[p.slot] = p.blocks
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(p.request.prompt, p.blocks)
-            self.prefix_cache.note_admission(p.start_pos,
-                                             len(p.request.prompt))
+            self.prefix_cache.insert(p.eff, p.blocks)
+            self.prefix_cache.note_admission(p.start_pos, len(p.eff))
             self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
-        self.active[p.slot] = _Slot(p.request, first, p.submitted_at,
-                                    self.clock())
+        self._check_replay(p.request, first)
+        st = self.active[p.slot] = _Slot(p.request, first, p.submitted_at,
+                                         self.clock())
         self.max_concurrent = max(self.max_concurrent, len(self.active))
         self._m_tokens.inc()  # the prefill's first token
         if (self.eos_token_id is not None
-                and first == self.eos_token_id):
+                and st.tokens[-1] == self.eos_token_id):
             self._finish(p.slot, "eos", done)
-        elif p.request.max_new_tokens <= 1:
+        elif len(st.tokens) >= p.request.max_new_tokens:
             self._finish(p.slot, "length", done)
 
     def _prefill_round(self, done: List[Completion]) -> None:
@@ -837,7 +901,7 @@ class Scheduler:
         head_bucket = None
         batch: List = []  # (row, chunk_len) pairs this round
         for p in self._pending_prefill:
-            m = min(chunk, len(p.request.prompt) - p.pos)
+            m = min(chunk, len(p.eff) - p.pos)
             bucket = next(b for b in self.engine.prefill_buckets if b >= m)
             if head_bucket is None:
                 head_bucket = bucket
@@ -847,7 +911,7 @@ class Scheduler:
             if len(batch) == self.prefill_batch:
                 break
         rows = [(p.slot,
-                 np.asarray(p.request.prompt[p.pos:p.pos + m], np.int32),
+                 np.asarray(p.eff[p.pos:p.pos + m], np.int32),
                  p.pos, p.row, p.request.temperature, p.request.top_p,
                  p.request.seed) for p, m in batch]
         t0 = self.clock()
@@ -859,7 +923,7 @@ class Scheduler:
         for (p, m), tok in zip(batch, toks):
             self._count_chunk()
             p.pos += m
-            if p.pos >= len(p.request.prompt):
+            if p.pos >= len(p.eff):
                 self._pending_prefill.remove(p)
                 self._finish_prefill(p, tok, done)
 

@@ -293,6 +293,12 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                    help="JSONL file tailed for requests in --follow mode "
                         "(one {'id','prompt',...} object per line; "
                         "complete lines only)")
+    p.add_argument("--journal-dir", default="",
+                   help="request-journal directory (inference/journal.py): "
+                        "a signal drain persists every unserved queued "
+                        "request as a requeue record there, so a fleet "
+                        "router (inference/router.py) can re-admit them on "
+                        "another host instead of losing them ('' = off)")
     p.add_argument("--adaptive-spec-k", action="store_true",
                    help="tune the speculative round width per request from "
                         "live acceptance (sampler.AdaptiveK): a stale "
@@ -572,6 +578,17 @@ def main(argv=None) -> None:
     # contract (the strict mode is for tests, via Scheduler.run)
     sched.audit_block_leaks(strict=False)
     if drained:
+        unserved = sched.unserved()
+        if args.journal_dir and unserved:
+            # zero-lost-requests half of the drain contract: what this
+            # process will not serve, the journal keeps (params + committed
+            # baseline) for a router to re-admit elsewhere
+            from .journal import RequestJournal, persist_unserved
+
+            journal = RequestJournal(args.journal_dir,
+                                     writer=f"serve_{os.getpid()}")
+            persist_unserved(journal, unserved,
+                             reason=f"drain_sig{flag.signum}")
         events.emit_audit(
             logger, AUDIT_SERVE_DRAINED_FMT.format(
                 completed=len(sched.completed), queued=len(sched.queue)),

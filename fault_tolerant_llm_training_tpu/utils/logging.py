@@ -110,3 +110,21 @@ AUDIT_RELOAD_FMT = ("[DEPLOY] Weights reloaded: step {old} -> {new} | "
 AUDIT_RELOAD_REJECTED_FMT = ("[DEPLOY] Publish of step {step} rejected: "
                              "{detail}; serving continues on step "
                              "{current}")
+
+# --- Serving-fleet audit trail (inference/fleet.py, inference/router.py) —
+# membership and migration lifecycle: hosts audit their own join/leave,
+# the router audits dead verdicts and migrations. scripts/chaos_campaign.py's
+# fleet scenario and tests/test_fleet.py grep these, frozen in
+# tests/test_audit_contract.py like the rest. ---
+AUDIT_FLEET_JOIN_FMT = ("[FLEET] Host {host} joined: {slots} slot(s), "
+                        "{blocks} free block(s), lease ttl {ttl:.1f}s")
+AUDIT_FLEET_LEAVE_FMT = "[FLEET] Host {host} left ({reason})"
+AUDIT_FLEET_DEAD_FMT = ("[FLEET] Host {host} declared dead: lease age "
+                        "{age:.1f}s > ttl {ttl:.1f}s; fencing and "
+                        "migrating {inflight} in-flight request(s)")
+AUDIT_FLEET_MIGRATE_FMT = ("[FLEET] Migrating request {id}: {src} -> {dst} "
+                           "(gen {gen}, {committed} committed token(s) "
+                           "replayed)")
+AUDIT_FLEET_REQUEUE_FMT = ("[FLEET] Requeued request {id} to the journal "
+                           "({committed} committed token(s), reason "
+                           "{reason})")

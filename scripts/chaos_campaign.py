@@ -36,6 +36,18 @@ periodic checkpoints every 5 steps):
                (verify-before-load) while continuing to serve on 30, and
                its post-swap output streams bit-match a fresh serve
                restored directly at step 30
+  fleet        serving-fleet migration (inference/fleet.py + router.py):
+               two fleet hosts register heartbeat leases; the router
+               admits 4 requests (3 greedy + 1 sampled) from an intake
+               file; host h0 is SIGKILLed mid-decode (host_kill, no
+               drain), the router's lease sweep declares it dead,
+               tombstones it and migrates its in-flight requests onto
+               h1, which replays each journaled committed prefix; h1
+               also absorbs a heartbeat_delay SHORTER than the ttl
+               (slow-but-alive must not trip the verdict). Zero lost
+               requests, survivor drains leak-clean, and every stream —
+               including the migrated, mid-decode ones — bit-matches an
+               unfailed single-host reference serve
 
 Bit-exactness evidence: full-precision ``loss`` floats from the step
 events, compared against a clean baseline run with the same seed; for
@@ -69,7 +81,7 @@ from fault_tolerant_llm_training_tpu.obs.goodput import (  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
-             "loader_stall", "deploy")
+             "loader_stall", "deploy", "fleet")
 # Known container-level post-restore native crash codes (SIGABRT/SIGSEGV,
 # as rc or negative signal): the resumed process dies after the restore
 # audits are flushed. Survival is then judged on the audit trail.
@@ -559,6 +571,163 @@ def run_deploy_scenario(work: str, parquet: str, seed: int) -> Result:
     return res
 
 
+def run_fleet_scenario(work: str, parquet: str, seed: int) -> Result:
+    """Serving-fleet migration scenario: SIGKILL one of two fleet hosts
+    mid-decode and prove the router migrates its in-flight requests onto
+    the survivor with zero loss and bit-exact continuations (module
+    docstring)."""
+    res = Result("fleet")
+    base = os.path.join(work, "fleet")
+    ckpts = os.path.join(base, "ckpts")
+    events_dir = os.path.join(ckpts, "events")
+    os.makedirs(base, exist_ok=True)
+    job = "fleet_a"
+
+    # 1. a checkpoint for the fleet to serve (short run; the scenario is
+    # about serving faults, not training ones)
+    rc, out = _run(_train_argv(parquet, ckpts, seed,
+                               **{"--training-steps": "10",
+                                  "--checkpoint-frequency": "5"}), job)
+    if not res.check(rc == 0, f"fleet training checkpoint committed "
+                              f"(got rc {rc})"):
+        return res
+
+    store = os.path.join(base, "store")
+    jdir = os.path.join(base, "journal")
+    intake = os.path.join(base, "intake.jsonl")
+    reqs = [
+        {"id": "req0", "prompt": "alpha bravo charlie delta",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 11},
+        {"id": "req1", "prompt": "echo foxtrot golf hotel",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 12},
+        {"id": "req2", "prompt": "india juliett kilo lima",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 13},
+        {"id": "req3", "prompt": "mike november oscar papa",
+         "max_new_tokens": 48, "temperature": 0.8, "seed": seed + 14},
+    ]
+    with open(intake, "w") as fh:
+        for r in reqs:
+            fh.write(json.dumps(r) + "\n")
+
+    def host_argv(hid, chaos):
+        return [sys.executable, "-m",
+                "fault_tolerant_llm_training_tpu.inference.fleet",
+                "--host-id", hid, "--store", store, "--journal-dir", jdir,
+                "--checkpoint-path", ckpts, "--checkpoint-job-id", job,
+                "--model", "tiny", "--tokenizer-name-or-path", "byte",
+                "--slots", "2", "--max-len", "256", "--no-eos",
+                "--lease-ttl", "2.0", "--max-run-seconds", "240",
+                "--seed", str(seed), "--chaos", chaos,
+                "--event-log", os.path.join(base, f"events_{hid}.jsonl")]
+
+    # 2. two hosts: h0 takes a SIGKILL at decode iteration 12 (mid-decode,
+    # committed tokens already journaled); h1 takes a 1 s heartbeat stall —
+    # SHORTER than the 2 s ttl, so it must NOT be declared dead
+    h0 = _ServeDriver(host_argv("h0", "step=12:host_kill"), "fleet_h0")
+    h1 = _ServeDriver(host_argv("h1", "step=3:heartbeat_delay=1s"),
+                      "fleet_h1")
+    router = None
+    try:
+        res.check(h0.wait_for(r"\[FLEET\] Host h0 joined", timeout=420)
+                  is not None, "host h0 joined the fleet with a lease")
+        res.check(h1.wait_for(r"\[FLEET\] Host h1 joined", timeout=420)
+                  is not None, "host h1 joined the fleet with a lease")
+
+        # 3. router admits the intake and supervises the leases
+        router = _ServeDriver(
+            [sys.executable, "-m",
+             "fault_tolerant_llm_training_tpu.inference.router",
+             "--store", store, "--journal-dir", jdir, "--intake", intake,
+             "--expected", "4", "--max-seconds", "180",
+             "--poll-seconds", "0.1",
+             "--event-log", os.path.join(base, "events_router.jsonl")],
+            "fleet_router")
+        rrc = router.finish(timeout=200)
+        res.check(rrc == 0, f"router completed and exited 0 (got {rrc})")
+        rc0 = h0.finish(timeout=15)
+        # 4. drain the survivor exactly like a single serve
+        h1.proc.send_signal(_signal.SIGUSR1)
+        rc1 = h1.finish(timeout=120)
+    finally:
+        for drv in (h0, h1, router):
+            if drv is not None and drv.proc.poll() is None:
+                drv.proc.kill()
+                drv.finish(timeout=10)
+    rout = router.output()
+    out0, out1 = h0.output(), h1.output()
+
+    res.check(rc0 == -9 and "[CHAOS] Injected host_kill" in out0,
+              f"host h0 SIGKILLed mid-decode by chaos (rc {rc0})")
+    res.check("[FLEET] Host h0 declared dead" in rout
+              and "fencing and migrating" in rout,
+              "router declared h0 dead and fenced it")
+    migrs = [int(n) for n in re.findall(
+        r"\[FLEET\] Migrating request req\d+: h0 -> h1 \(gen \d+, (\d+) "
+        r"committed token\(s\) replayed\)", rout)]
+    res.check(bool(migrs) and any(n >= 1 for n in migrs),
+              f"migration replayed a committed prefix onto the survivor "
+              f"(committed counts {migrs})")
+    res.check(re.search(r"Fleet router complete: 4 request\(s\) done, "
+                        r"\d+ migrated, 0 lost", rout) is not None,
+              "zero requests lost: all 4 served")
+    res.check("Injected heartbeat_delay" in out1
+              and "Host h1 declared dead" not in rout,
+              "heartbeat-delayed h1 stayed under its ttl (no false dead "
+              "verdict)")
+    res.check(rc1 == 0 and "Fleet drain leak guard: clean" in out1,
+              f"survivor drained leak-clean and exited 0 (got rc {rc1})")
+
+    # flight recorder agrees with the log lines: one dead verdict, at
+    # least one migration, no verdict against the slow-but-alive host
+    kinds = []
+    ev_path = os.path.join(base, "events_router.jsonl")
+    if os.path.isfile(ev_path):
+        with open(ev_path) as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                kinds.append((ev.get("kind"), ev.get("host")))
+    res.check(kinds.count(("fleet_dead", "h0")) == 1
+              and ("fleet_dead", "h1") not in kinds
+              and sum(1 for k, _ in kinds if k == "fleet_migrate") >= 1,
+              "flight recorder: exactly one dead verdict (h0) + the "
+              "migrations")
+
+    # 5. unfailed reference: ONE serve.py tails the same intake (same ids,
+    # seeds, sampling params) — every fleet stream, including the
+    # migrated mid-decode ones, must bit-match it
+    ref_reqs = os.path.join(base, "ref_requests.jsonl")
+    shutil.copy(intake, ref_reqs)
+    ref = _ServeDriver(_serve_argv(ckpts, job, [
+        "--seed", str(seed), "--follow", "--poll-seconds", "0.2",
+        "--request-file", ref_reqs]), "fleet_ref")
+    try:
+        for r in reqs:
+            res.check(ref.wait_for(rf"Request {r['id']} output: ",
+                                   timeout=420) is not None,
+                      f"reference serve completed {r['id']}")
+        ref.proc.send_signal(_signal.SIGUSR1)
+        ref_rc = ref.finish()
+    finally:
+        if ref.proc.poll() is None:
+            ref.proc.kill()
+            ref.finish(timeout=10)
+    res.check(ref_rc == 0, f"reference serve exited 0 (got {ref_rc})")
+    fleet_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                    out0 + "\n" + out1))
+    ref_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                  ref.output()))
+    res.check(
+        len(fleet_outputs) == 4 and all(
+            fleet_outputs.get(f"req{i}") == ref_outputs.get(f"req{i}")
+            for i in range(4)),
+        "migrated streams bit-identical to the unfailed reference serve")
+    _stitch_scenario(res, events_dir)
+    return res
+
+
 def format_report(results, seed: int, wall: float, extra_notes) -> str:
     lines = []
     lines.append("Chaos survival campaign")
@@ -634,6 +803,8 @@ def main(argv=None) -> int:
         print(f"== scenario: {name}")
         if name == "deploy":
             res = run_deploy_scenario(work, parquet, args.seed)
+        elif name == "fleet":
+            res = run_fleet_scenario(work, parquet, args.seed)
         else:
             res = run_scenario(name, work, parquet, args.seed,
                                baseline_losses, sbatch=args.sbatch)

@@ -12,7 +12,10 @@
 #    committed receipt logs/chaos_campaign.txt (goodput and MTTR
 #    columns are wall-clock noisy, so only class + survived are pinned;
 #    a class flipping to "no" fails the night) and the deploy drill's
-#    key checks pinned line-for-line;
+#    key checks pinned line-for-line; the fleet scenario (two heartbeat-
+#    leased hosts, one SIGKILLed mid-decode, the router fences it and
+#    migrates its journaled requests onto the survivor with bit-exact
+#    replayed continuations) is pinned the same way;
 # 3. shared_prefix decode bench — re-runs the prefix-caching scenario
 #    and holds it to the committed BENCH_decode_prefix_cpu.json
 #    acceptance bars: cached N=8 prefill <= 2x N=1 and
@@ -92,6 +95,26 @@ do
     fi
 done
 echo "ok: deploy drill (publish -> hot reload -> verify) checks present"
+
+# the fleet migration drill's substance: the SIGKILLed host was
+# declared dead and fenced, its requests were migrated with a committed
+# prefix replayed, nothing was lost, the slow-but-alive host was NOT
+# declared dead, the survivor drained leak-clean, and every stream
+# bit-matched an unfailed single-host reference serve
+for want in \
+    "ok: host h0 SIGKILLed mid-decode by chaos (rc -9)" \
+    "ok: router declared h0 dead and fenced it" \
+    "ok: zero requests lost: all 4 served" \
+    "ok: heartbeat-delayed h1 stayed under its ttl (no false dead verdict)" \
+    "ok: survivor drained leak-clean and exited 0 (got rc 0)" \
+    "ok: migrated streams bit-identical to the unfailed reference serve"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: fleet drill check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: fleet drill (lease -> dead verdict -> fence -> migrate) checks present"
 
 echo "== shared_prefix bench vs committed receipt"
 python scripts/decode_bench.py --scenario shared_prefix \
@@ -203,4 +226,4 @@ print(f"ok: tree {got['best_shape']} {got['value']}x linear accepted/"
       f"all drains leak-clean")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, prefix bench, fused decode, packed prefill, tree spec)"
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, prefix bench, fused decode, packed prefill, tree spec)"

@@ -99,6 +99,20 @@ FROZEN = {
     "AUDIT_RELOAD_REJECTED_FMT":
         "[DEPLOY] Publish of step {step} rejected: {detail}; serving "
         "continues on step {current}",
+    "AUDIT_FLEET_JOIN_FMT":
+        "[FLEET] Host {host} joined: {slots} slot(s), {blocks} free "
+        "block(s), lease ttl {ttl:.1f}s",
+    "AUDIT_FLEET_LEAVE_FMT": "[FLEET] Host {host} left ({reason})",
+    "AUDIT_FLEET_DEAD_FMT":
+        "[FLEET] Host {host} declared dead: lease age {age:.1f}s > ttl "
+        "{ttl:.1f}s; fencing and migrating {inflight} in-flight "
+        "request(s)",
+    "AUDIT_FLEET_MIGRATE_FMT":
+        "[FLEET] Migrating request {id}: {src} -> {dst} (gen {gen}, "
+        "{committed} committed token(s) replayed)",
+    "AUDIT_FLEET_REQUEUE_FMT":
+        "[FLEET] Requeued request {id} to the journal ({committed} "
+        "committed token(s), reason {reason})",
 }
 
 
