@@ -43,11 +43,12 @@ from ..data.tokenizer import load_tokenizer
 from ..ft.lease import FileKVStore, LeaseRegistry
 from ..ft.signals import SignalFlag
 from ..models.configs import get_config
-from ..obs import events
+from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
 from ..utils.logging import (
     AUDIT_FLEET_JOIN_FMT,
     AUDIT_FLEET_LEAVE_FMT,
+    AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
     AUDIT_SERVE_DRAINING_FMT,
     AUDIT_SERVE_READY_FMT,
@@ -145,6 +146,9 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                         "(0 = run until signaled)")
     p.add_argument("--metrics-port", type=int, default=0)
     p.add_argument("--event-log", default="")
+    p.add_argument("--trace-log", default="",
+                   help="request-span trail (obs/reqtrace.py); defaults "
+                        "to trace_<name>.jsonl next to --event-log")
     p.add_argument("--chaos", default="",
                    help="fault schedule: host_kill / sigusr1 / sigterm "
                         "keyed by decode iteration (serve.py convention); "
@@ -166,6 +170,12 @@ def main(argv=None) -> None:
     if args.event_log:
         events.configure(args.event_log, job=f"fleet_{args.host_id}",
                          host=os.getpid())
+    trace_log = args.trace_log or (
+        reqtrace.derive_trace_path(args.event_log) if args.event_log
+        else "")
+    if trace_log:
+        reqtrace.configure(trace_log, job=f"fleet_{args.host_id}",
+                           host=args.host_id)
     metrics_server = None
     if args.metrics_port:
         metrics_server = MetricsServer(port=args.metrics_port)
@@ -237,7 +247,7 @@ def main(argv=None) -> None:
         for c in sched.completed[n_done:]:
             gen = gens.get(c.request_id, 0)
             journal.done(c.request_id, args.host_id, c.tokens, c.reason,
-                         gen=gen)
+                         gen=gen, trace_id=c.trace_id)
             done_ids.add(c.request_id)
             decoded = (c.tokens[:-1]
                        if (not args.no_eos and c.reason == "eos")
@@ -269,6 +279,7 @@ def main(argv=None) -> None:
                     host=args.host_id, reason="fenced"),
                 "fleet_leave", host=args.host_id, reason="fenced")
             events.flush()
+            reqtrace.flush()
             if metrics_server is not None:
                 metrics_server.stop()
             sys.exit(0)
@@ -280,6 +291,7 @@ def main(argv=None) -> None:
                 continue  # stale or duplicate assignment
             gens[rid] = gen
             committed = [int(t) for t in rec.get("committed") or []]
+            trace_id = str(rec.get("trace_id", "") or "")
             try:
                 sched.submit(Request(
                     id=rid,
@@ -288,9 +300,15 @@ def main(argv=None) -> None:
                     temperature=float(rec.get("temperature", 0.0)),
                     top_p=float(rec.get("top_p", 1.0)),
                     seed=int(rec.get("seed", 0)),
-                    committed=tuple(committed)))
+                    committed=tuple(committed),
+                    trace_id=trace_id))
             except ValueError as e:
                 logger.warning(f"[FLEET] rejecting assignment {rid}: {e}")
+                continue
+            if trace_id:
+                reqtrace.emit(trace_id, rid, "assign", gen=gen,
+                              committed=len(committed),
+                              kind=str(rec.get("kind", "assign")))
 
         if flag.signum is not None:
             exit_reason = "drain"
@@ -315,7 +333,8 @@ def main(argv=None) -> None:
             # every active slot — the baseline a migration replays from
             for st in sched.active.values():
                 journal.progress(st.request.id, args.host_id, st.tokens,
-                                 gen=gens.get(st.request.id, 0))
+                                 gen=gens.get(st.request.id, 0),
+                                 trace_id=st.request.trace_id)
             if sched.iterations % args.log_frequency == 0:
                 logger.info(
                     "Fleet host %s | iter %d | active %d | queued %d | "
@@ -337,7 +356,8 @@ def main(argv=None) -> None:
         emit_completions()
         for st in sched.active.values():
             journal.progress(st.request.id, args.host_id, st.tokens,
-                             gen=gens.get(st.request.id, 0))
+                             gen=gens.get(st.request.id, 0),
+                             trace_id=st.request.trace_id)
     emit_completions()
     persist_unserved(journal, sched.unserved(), reason=exit_reason,
                      gens=gens)
@@ -347,12 +367,24 @@ def main(argv=None) -> None:
     else:
         logger.warning("Fleet drain leak guard: %d violation(s)",
                        len(leaks))
+    # Per-request latency audit: the drain summary every SLO check greps.
+    for c in sched.completed:
+        events.emit_audit(
+            logger, AUDIT_LATENCY_FMT.format(
+                id=c.request_id, trace=c.trace_id or "-",
+                ttft_ms=c.ttft_seconds * 1e3,
+                tpot_ms=c.tpot_seconds * 1e3,
+                tokens=len(c.tokens), reason=c.reason),
+            "latency", id=c.request_id, trace=c.trace_id,
+            ttft=c.ttft_seconds, tpot=c.tpot_seconds,
+            tokens=len(c.tokens), reason=c.reason)
     events.emit_audit(
         logger, AUDIT_FLEET_LEAVE_FMT.format(
             host=args.host_id, reason=exit_reason),
         "fleet_leave", host=args.host_id, reason=exit_reason)
     lease.leave()
     events.flush()
+    reqtrace.flush()
     if metrics_server is not None:
         metrics_server.stop()
     # exit 0 always — the exit POLICY is in the logs, same contract as

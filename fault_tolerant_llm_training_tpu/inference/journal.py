@@ -54,6 +54,7 @@ class RequestState:
     reason: str = ""
     migrations: int = 0
     requeued: bool = False         # latest ownership record is a requeue
+    trace_id: str = ""             # obs/reqtrace.py span-trail key
 
 
 class RequestJournal:
@@ -79,47 +80,51 @@ class RequestJournal:
     # ------------------------------------------------------------ record kinds
     def assign(self, request_id: str, host: str, prompt: List[int],
                max_new_tokens: int, temperature: float, top_p: float,
-               seed: int) -> None:
+               seed: int, trace_id: str = "") -> None:
         self._append({"kind": "assign", "id": request_id, "host": host,
                       "prompt": [int(t) for t in prompt],
                       "max_new_tokens": int(max_new_tokens),
                       "temperature": float(temperature),
-                      "top_p": float(top_p), "seed": int(seed), "gen": 0})
+                      "top_p": float(top_p), "seed": int(seed), "gen": 0,
+                      "trace_id": str(trace_id)})
 
     def progress(self, request_id: str, host: str, committed: List[int],
-                 gen: int) -> None:
+                 gen: int, trace_id: str = "") -> None:
         self._append({"kind": "progress", "id": request_id, "host": host,
                       "committed": [int(t) for t in committed],
-                      "gen": int(gen)})
+                      "gen": int(gen), "trace_id": str(trace_id)})
 
     def done(self, request_id: str, host: str, tokens: List[int],
-             reason: str, gen: int) -> None:
+             reason: str, gen: int, trace_id: str = "") -> None:
         self._append({"kind": "done", "id": request_id, "host": host,
                       "tokens": [int(t) for t in tokens],
-                      "reason": reason, "gen": int(gen)})
+                      "reason": reason, "gen": int(gen),
+                      "trace_id": str(trace_id)})
 
     def migrate(self, request_id: str, src: str, dst: str, gen: int,
                 prompt: List[int], max_new_tokens: int, temperature: float,
-                top_p: float, seed: int, committed: List[int]) -> None:
+                top_p: float, seed: int, committed: List[int],
+                trace_id: str = "") -> None:
         self._append({"kind": "migrate", "id": request_id, "src": src,
                       "host": dst, "gen": int(gen),
                       "prompt": [int(t) for t in prompt],
                       "max_new_tokens": int(max_new_tokens),
                       "temperature": float(temperature),
                       "top_p": float(top_p), "seed": int(seed),
-                      "committed": [int(t) for t in committed]})
+                      "committed": [int(t) for t in committed],
+                      "trace_id": str(trace_id)})
 
     def requeue(self, request_id: str, prompt: List[int],
                 max_new_tokens: int, temperature: float, top_p: float,
                 seed: int, committed: List[int], gen: int,
-                host: Optional[str] = None) -> None:
+                host: Optional[str] = None, trace_id: str = "") -> None:
         self._append({"kind": "requeue", "id": request_id, "host": host,
                       "prompt": [int(t) for t in prompt],
                       "max_new_tokens": int(max_new_tokens),
                       "temperature": float(temperature),
                       "top_p": float(top_p), "seed": int(seed),
                       "committed": [int(t) for t in committed],
-                      "gen": int(gen)})
+                      "gen": int(gen), "trace_id": str(trace_id)})
 
 
 def persist_unserved(journal: "RequestJournal", requests, reason: str,
@@ -130,21 +135,25 @@ def persist_unserved(journal: "RequestJournal", requests, reason: str,
     router can re-admit later. The requeue is written at gen+1 of the
     request's current assignment so it outranks the old ``assign`` in
     :func:`fold` regardless of file read order. Returns the count."""
-    from ..obs import events
+    from ..obs import events, reqtrace
     from ..utils.logging import AUDIT_FLEET_REQUEUE_FMT, logger
 
     n = 0
     for req in requests:
         committed = [int(t) for t in getattr(req, "committed", ()) or ()]
         gen = int((gens or {}).get(req.id, 0)) + 1
+        trace_id = str(getattr(req, "trace_id", "") or "")
         journal.requeue(req.id, list(req.prompt), req.max_new_tokens,
                         req.temperature, req.top_p, req.seed, committed,
-                        gen=gen)
+                        gen=gen, trace_id=trace_id)
         events.emit_audit(
             logger, AUDIT_FLEET_REQUEUE_FMT.format(
                 id=req.id, committed=len(committed), reason=reason),
             "fleet_requeue", id=req.id, committed=len(committed),
             reason=reason, gen=gen)
+        if trace_id:
+            reqtrace.emit(trace_id, req.id, "requeue",
+                          committed=len(committed), reason=reason, gen=gen)
         n += 1
     return n
 
@@ -192,6 +201,8 @@ def fold(root: str) -> Dict[str, RequestState]:
             st = states[rid] = RequestState(request_id=rid)
         kind = rec.get("kind")
         gen = int(rec.get("gen", 0))
+        if rec.get("trace_id"):
+            st.trace_id = str(rec["trace_id"])
         if kind in ("assign", "migrate", "requeue"):
             if gen >= st.gen:
                 st.gen = gen

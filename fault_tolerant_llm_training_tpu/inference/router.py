@@ -44,7 +44,7 @@ from typing import Dict, Optional
 
 from ..data.tokenizer import load_tokenizer
 from ..ft.lease import FileKVStore, LeaseRegistry
-from ..obs import events
+from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
 from ..obs.registry import REGISTRY
 from ..utils.logging import (
@@ -89,15 +89,21 @@ class Router:
 
     # ---------------------------------------------------------------- intake
     def submit(self, request_id: str, prompt, max_new_tokens: int,
-               temperature: float, top_p: float, seed: int) -> bool:
+               temperature: float, top_p: float, seed: int,
+               trace_id: str = "") -> bool:
         if request_id in self.pending_ids or request_id in self.assigned:
             return False
+        trace_id = str(trace_id or reqtrace.mint_trace_id(request_id))
         self.pending.append({
             "id": request_id, "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature), "top_p": float(top_p),
-            "seed": int(seed), "committed": [], "gen": 0, "src": None})
+            "seed": int(seed), "committed": [], "gen": 0, "src": None,
+            "trace_id": trace_id, "enqueued": self.clock()})
         self.pending_ids.add(request_id)
+        reqtrace.emit(trace_id, request_id, "intake",
+                      prompt_tokens=len(prompt),
+                      max_new_tokens=int(max_new_tokens))
         return True
 
     # ------------------------------------------------------------- membership
@@ -152,23 +158,32 @@ class Router:
                 "max_new_tokens": st.max_new_tokens,
                 "temperature": st.temperature, "top_p": st.top_p,
                 "seed": st.seed, "committed": list(st.committed),
-                "gen": st.gen, "src": src}
+                "gen": st.gen, "src": src, "trace_id": st.trace_id,
+                "enqueued": self.clock()}
 
     def _admit(self, item: dict, dst: str) -> None:
         """Journal one admission: a fresh ``assign`` at gen 0, or a
         ``migrate`` at gen+1 for anything carrying history."""
         rid = item["id"]
+        trace_id = str(item.get("trace_id", "") or "")
+        wait = self.clock() - item.get("enqueued", self.clock())
         if item["gen"] == 0 and item["src"] is None:
             self.journal.assign(rid, dst, item["prompt"],
                                 item["max_new_tokens"], item["temperature"],
-                                item["top_p"], item["seed"])
+                                item["top_p"], item["seed"],
+                                trace_id=trace_id)
             self.assigned[rid] = (dst, 0)
+            if trace_id:
+                reqtrace.emit(trace_id, rid, "queue", dur=max(wait, 0.0),
+                              where="router")
+                reqtrace.emit(trace_id, rid, "placement", host=dst, gen=0)
         else:
             gen = item["gen"] + 1
             self.journal.migrate(rid, item["src"], dst, gen,
                                  item["prompt"], item["max_new_tokens"],
                                  item["temperature"], item["top_p"],
-                                 item["seed"], item["committed"])
+                                 item["seed"], item["committed"],
+                                 trace_id=trace_id)
             self.assigned[rid] = (dst, gen)
             self.migrated_total += 1
             _M_MIGRATED.inc()
@@ -178,6 +193,10 @@ class Router:
                     committed=len(item["committed"])),
                 "fleet_migrate", id=rid, src=item["src"], dst=dst,
                 gen=gen, committed=len(item["committed"]))
+            if trace_id:
+                reqtrace.emit(trace_id, rid, "migration", src=item["src"],
+                              dst=dst, gen=gen,
+                              replayed=len(item["committed"]))
         self._charge(dst, item)
 
     def sweep(self, now: Optional[float] = None) -> int:
@@ -208,7 +227,8 @@ class Router:
                     # to decode; the router completes it in place
                     self.journal.done(st.request_id, "router",
                                       st.committed, "length",
-                                      gen=st.gen + 1)
+                                      gen=st.gen + 1,
+                                      trace_id=st.trace_id)
                     continue
                 item = self._item_from_state(st, src=h)
                 if st.request_id not in self.pending_ids:
@@ -307,7 +327,8 @@ class _IntakeFollower:
                     int(d.get("max_new_tokens", self.args.max_new_tokens)),
                     float(d.get("temperature", self.args.temperature)),
                     float(d.get("top_p", self.args.top_p)),
-                    int(d.get("seed", self.args.seed + self.count))):
+                    int(d.get("seed", self.args.seed + self.count)),
+                    trace_id=str(d.get("trace_id", "") or "")):
                 n += 1
         return n
 
@@ -340,6 +361,9 @@ def get_router_args(argv=None) -> argparse.Namespace:
                         "finished by then")
     p.add_argument("--metrics-port", type=int, default=0)
     p.add_argument("--event-log", default="")
+    p.add_argument("--trace-log", default="",
+                   help="request-span JSONL (obs/reqtrace.py); defaults "
+                        "to trace_<name>.jsonl next to --event-log")
     return p.parse_args(argv)
 
 
@@ -348,6 +372,11 @@ def main(argv=None) -> int:
     init_logger()
     if args.event_log:
         events.configure(args.event_log, job="router", host=os.getpid())
+    trace_log = args.trace_log or (
+        reqtrace.derive_trace_path(args.event_log) if args.event_log
+        else "")
+    if trace_log:
+        reqtrace.configure(trace_log, job="router", host="router")
     metrics_server = None
     if args.metrics_port:
         metrics_server = MetricsServer(port=args.metrics_port)
@@ -384,6 +413,7 @@ def main(argv=None) -> int:
     logger.info("Fleet router complete: %d request(s) done, %d migrated, "
                 "%d lost", done, router.migrated_total, lost)
     events.flush()
+    reqtrace.flush()
     if metrics_server is not None:
         metrics_server.stop()
     return rc

@@ -97,7 +97,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..obs import events
+from ..obs import events, reqtrace
 from ..obs.registry import (
     SPEC_TOKEN_BUCKETS,
     MetricRegistry,
@@ -196,6 +196,10 @@ class Request:
     # stream the original host was producing. committed counts toward
     # max_new_tokens; an empty tuple is a normal fresh request.
     committed: Sequence[int] = ()
+    # Span-trail key (obs/reqtrace.py), minted at intake and carried
+    # through the journal so a migrated request's trace joins across
+    # hosts. Empty string = tracing off for this request.
+    trace_id: str = ""
 
 
 @dataclasses.dataclass
@@ -216,6 +220,7 @@ class Completion:
     spec_proposed: int = 0
     spec_accepted: int = 0
     spec_emitted_not_proposed: int = 0
+    trace_id: str = ""
 
     @property
     def ttft_seconds(self) -> float:
@@ -225,6 +230,15 @@ class Completion:
     @property
     def latency_seconds(self) -> float:
         return self.finished_at - self.submitted_at
+
+    @property
+    def tpot_seconds(self) -> float:
+        """Time per output token AFTER the first (the first token is
+        prefill's and is priced by TTFT — the DistServe/Splitwise
+        split). 0.0 for single-token requests."""
+        decoded = len(self.tokens) - 1
+        dt = self.finished_at - self.first_token_at
+        return dt / decoded if decoded > 0 and dt > 0 else 0.0
 
     @property
     def decode_tokens_per_sec(self) -> float:
@@ -415,6 +429,12 @@ class Scheduler:
         self._m_ttft = r.histogram(
             "ftl_serve_ttft_seconds",
             "Time to first token (queue wait + prefill) per request")
+        self._m_tpot = r.histogram(
+            "ftl_serve_tpot_seconds",
+            "Time per output token after the first (decode-loop latency "
+            "per token, per request)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
         self._m_decode = r.histogram(
             "ftl_serve_decode_step_seconds",
             "Wall time of one batched decode iteration")
@@ -637,11 +657,27 @@ class Scheduler:
                        finished_at=self.clock(),
                        spec_proposed=st.spec_proposed,
                        spec_accepted=st.spec_accepted,
-                       spec_emitted_not_proposed=st.spec_corrected)
+                       spec_emitted_not_proposed=st.spec_corrected,
+                       trace_id=str(getattr(st.request, "trace_id", "")
+                                    or ""))
         self.completed.append(c)
         done.append(c)
         self._m_ttft.observe(c.ttft_seconds)
+        if len(c.tokens) > 1:
+            self._m_tpot.observe(c.tpot_seconds)
         self._m_done.labels(reason=reason).inc()
+        self._trace(st.request, "done", reason=reason,
+                    tokens=len(c.tokens), ttft=c.ttft_seconds,
+                    tpot=c.tpot_seconds)
+
+    def _trace(self, request: Request, span: str,
+               dur: Optional[float] = None, **payload) -> None:
+        """Emit one reqtrace span for a traced request (no-op when the
+        request carries no trace_id — direct Scheduler users like the
+        bench driver opt out by default)."""
+        tid = str(getattr(request, "trace_id", "") or "")
+        if tid:
+            reqtrace.emit(tid, request.id, span, dur=dur, **payload)
 
     def _count_chunk(self) -> None:
         self.prefill_chunks += 1
@@ -729,6 +765,8 @@ class Scheduler:
                         break
             self.queue.popleft()
             slot = free.pop(0)
+            self._trace(req, "queue", dur=self.clock() - submitted_at,
+                        slot=slot)
             if self.kv_layout == "paged":
                 start_pos = 0
                 slot_blocks = blocks
@@ -798,7 +836,8 @@ class Scheduler:
                     temperature=req.temperature, top_p=req.top_p,
                     seed=req.seed, stop_check=self._drain_requested,
                     on_chunk=self._count_chunk, **spec_kw)
-                self.prefill_seconds += self.clock() - t0
+                pf_dur = self.clock() - t0
+                self.prefill_seconds += pf_dur
                 if first is None:
                     # Drain fired mid-prompt: the engine finished the
                     # current chunk and stopped. Free the slot's blocks
@@ -831,10 +870,16 @@ class Scheduler:
                 first = self.engine.prefill(slot, eff,
                                             temperature=req.temperature,
                                             top_p=req.top_p, seed=req.seed)
-                self.prefill_seconds += self.clock() - t0
+                pf_dur = self.clock() - t0
+                self.prefill_seconds += pf_dur
             self._check_replay(req, first)
             st = self.active[slot] = _Slot(req, first, submitted_at,
                                            self.clock())
+            self._trace(req, "prefill", dur=pf_dur,
+                        prompt_tokens=len(eff), packed=False,
+                        replayed=len(list(req.committed or ())))
+            self._trace(req, "first_token",
+                        ttft=st.first_token_at - st.submitted_at)
             self.max_concurrent = max(self.max_concurrent, len(self.active))
             self._m_tokens.inc()  # the prefill's first token
             # a request can finish straight out of prefill (a replay can
@@ -874,6 +919,11 @@ class Scheduler:
         self._check_replay(p.request, first)
         st = self.active[p.slot] = _Slot(p.request, first, p.submitted_at,
                                          self.clock())
+        self._trace(p.request, "prefill", prompt_tokens=len(p.eff),
+                    packed=True,
+                    replayed=len(list(p.request.committed or ())))
+        self._trace(p.request, "first_token",
+                    ttft=st.first_token_at - st.submitted_at)
         self.max_concurrent = max(self.max_concurrent, len(self.active))
         self._m_tokens.inc()  # the prefill's first token
         if (self.eos_token_id is not None
@@ -1073,6 +1123,7 @@ class Scheduler:
             self.decode_tokens += 1
             self._m_tokens.inc()
             self._m_burst_tokens.observe(1)
+            self._trace(st.request, "decode_round", tokens=1, mode="token")
             if self.eos_token_id is not None and tok == self.eos_token_id:
                 self._finish(s, "eos", done)
             elif len(st.tokens) >= st.request.max_new_tokens:
@@ -1107,6 +1158,8 @@ class Scheduler:
                     break
             self.decode_tokens += banked
             self._m_burst_tokens.observe(banked)
+            self._trace(st.request, "decode_round", tokens=banked,
+                        mode="burst")
             if finished:
                 self._finish(s, finished, done)
 
@@ -1154,6 +1207,8 @@ class Scheduler:
             self.decode_tokens += banked
             self._m_spec_round_tokens.observe(banked)
             self._m_burst_tokens.observe(banked)
+            self._trace(st.request, "decode_round", tokens=banked,
+                        mode="spec", accepted=a)
             if finished:
                 self._finish(s, finished, done)
         self.spec_accepted_tokens += round_accepted
@@ -1218,6 +1273,8 @@ class Scheduler:
             self.decode_tokens += banked
             self._m_spec_round_tokens.observe(banked)
             self._m_burst_tokens.observe(banked)
+            self._trace(st.request, "decode_round", tokens=banked,
+                        mode="tree", accepted=a)
             if finished:
                 self._finish(s, finished, done)
         self.spec_accepted_tokens += round_accepted
@@ -1321,6 +1378,14 @@ class Scheduler:
                 self.decode_host_syncs / self.decode_tokens
                 if self.decode_tokens else 0.0),
         }
+        ttfts = [c.ttft_seconds for c in self.completed]
+        tpots = [c.tpot_seconds for c in self.completed
+                 if len(c.tokens) > 1]
+        for name, vals in (("ttft", ttfts), ("tpot", tpots)):
+            arr = np.asarray(vals or [0.0])
+            for q in (50, 95, 99):
+                out[f"{name}_p{q}_ms"] = float(
+                    np.percentile(arr, q) * 1e3)
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count

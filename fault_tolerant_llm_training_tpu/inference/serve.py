@@ -36,10 +36,11 @@ from ..data.tokenizer import load_tokenizer
 from ..deploy.reload import HotReloader, PointerWatcher
 from ..ft.signals import SignalFlag
 from ..models.configs import get_config
-from ..obs import events
+from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
 from ..utils.config import JOBID
 from ..utils.logging import (
+    AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
     AUDIT_SERVE_COMPLETED,
     AUDIT_SERVE_DRAINED_FMT,
@@ -110,14 +111,22 @@ class _RequestFollower:
                 continue
             rid = str(d.get("id", f"file{self.count}"))
             self.count += 1
+            # the driver may carry its own trace_id (a router intake that
+            # this serve process replays); otherwise mint one here — the
+            # span trail starts at whichever process saw the request first
+            max_new = int(d.get("max_new_tokens", self.args.max_new_tokens))
+            trace_id = (str(d.get("trace_id", "") or "")
+                        or reqtrace.mint_trace_id(rid))
+            reqtrace.emit(trace_id, rid, "intake",
+                          prompt_tokens=len(prompt), max_new_tokens=max_new)
             sched.submit(Request(
                 id=rid, prompt=prompt,
-                max_new_tokens=int(d.get("max_new_tokens",
-                                         self.args.max_new_tokens)),
+                max_new_tokens=max_new,
                 temperature=float(d.get("temperature",
                                         self.args.temperature)),
                 top_p=float(d.get("top_p", self.args.top_p)),
-                seed=int(d.get("seed", self.args.seed + self.count))))
+                seed=int(d.get("seed", self.args.seed + self.count)),
+                trace_id=trace_id))
             n += 1
         return n
 
@@ -272,6 +281,10 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "(0 = disabled); TTFT, decode-step, slot occupancy")
     p.add_argument("--event-log", default="",
                    help="flight-recorder JSONL path ('' = disabled)")
+    p.add_argument("--trace-log", default="",
+                   help="request-span trail JSONL (obs/reqtrace.py); "
+                        "defaults to trace_<name>.jsonl next to "
+                        "--event-log ('' with no --event-log = disabled)")
     p.add_argument("--chaos", default="",
                    help="fault schedule keyed by decode iteration "
                         "('step=<N>:sigusr1' / 'step=<N>:sigterm'; "
@@ -325,6 +338,12 @@ def main(argv=None) -> None:
     if args.event_log:
         events.configure(args.event_log, job=JOBID or "serve",
                          host=os.getpid())
+    trace_log = args.trace_log or (
+        reqtrace.derive_trace_path(args.event_log) if args.event_log
+        else "")
+    if trace_log:
+        reqtrace.configure(trace_log, job=JOBID or "serve",
+                           host=os.getpid())
     metrics_server = None
     if args.metrics_port:
         metrics_server = MetricsServer(port=args.metrics_port)
@@ -409,11 +428,17 @@ def main(argv=None) -> None:
         prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
                    ) * args.repeat
         for i, text in enumerate(prompts):
+            rid = f"req{i}"
+            prompt = tokenizer.encode(text)
+            trace_id = reqtrace.mint_trace_id(rid)
+            reqtrace.emit(trace_id, rid, "intake",
+                          prompt_tokens=len(prompt),
+                          max_new_tokens=args.max_new_tokens)
             sched.submit(Request(
-                id=f"req{i}", prompt=tokenizer.encode(text),
+                id=rid, prompt=prompt,
                 max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature, top_p=args.top_p,
-                seed=args.seed + i))
+                seed=args.seed + i, trace_id=trace_id))
         watcher = reloader = follower = None
         if args.follow:
             watcher = PointerWatcher(args.checkpoint_path)
@@ -464,7 +489,19 @@ def main(argv=None) -> None:
         if reloader is not None and not drained:
             # between decode iterations — the in-flight round is finished,
             # so this is exactly the swap's prefill-pause point
-            reloader.maybe_reload(watcher.poll())
+            old_step = engine.restored_step
+            t_swap = time.monotonic()
+            if reloader.maybe_reload(watcher.poll()):
+                # a swap stalled every in-flight request for its duration:
+                # pin the pause on each active trace so a latency report
+                # attributes the decode gap to the reload, not the model
+                pause = time.monotonic() - t_swap
+                for st in sched.active.values():
+                    tid = getattr(st.request, "trace_id", "")
+                    if tid:
+                        reqtrace.emit(tid, st.request.id, "reload_pause",
+                                      dur=pause, old=old_step,
+                                      new=engine.restored_step)
         for c in sched.step():
             decoded = c.tokens[:-1] if (not args.no_eos and c.reason == "eos"
                                         ) else c.tokens
@@ -573,6 +610,19 @@ def main(argv=None) -> None:
             cached_blocks=m["prefix_cached_blocks"],
             cow_copies=m["prefix_cow_copies"],
             evictions=m["prefix_evictions"])
+    # Per-request latency audit: the drain summary's SLO receipt — TTFT
+    # and TPOT per completed request, keyed by the trace id that joins
+    # this process's spans to the router's (obs/reqtrace.py)
+    for c in sched.completed:
+        events.emit_audit(
+            logger, AUDIT_LATENCY_FMT.format(
+                id=c.request_id, trace=c.trace_id or "-",
+                ttft_ms=c.ttft_seconds * 1e3,
+                tpot_ms=c.tpot_seconds * 1e3,
+                tokens=len(c.tokens), reason=c.reason),
+            "latency", id=c.request_id, trace=c.trace_id,
+            ttft=c.ttft_seconds, tpot=c.tpot_seconds,
+            tokens=len(c.tokens), reason=c.reason)
     # leak guard: with the loop idle, every block must be free or
     # cache-held; violations audit once ([KV LEAK]) but keep the exit-0
     # contract (the strict mode is for tests, via Scheduler.run)
@@ -596,6 +646,7 @@ def main(argv=None) -> None:
             queued=len(sched.queue))
     events.emit_audit(logger, AUDIT_SERVE_COMPLETED, "complete")
     events.flush()
+    reqtrace.flush()
     if metrics_server is not None:
         metrics_server.stop()
     # exit 0 always — same contract as training: the exit POLICY is in the

@@ -183,6 +183,15 @@ class _Family:
                              f" {_fmt_value(child.sum)}")
                 lines.append(f"{self.name}_count{_fmt_labels(key)}"
                              f" {child.count}")
+                # Summary-style quantile snapshots next to the buckets:
+                # operators read p50/p95/p99 off one scrape instead of
+                # integrating _bucket lines by hand. Bucket-resolution
+                # (Histogram.quantile), good enough for SLO eyeballing.
+                for q in (0.5, 0.95, 0.99):
+                    quant = 'quantile="%s"' % _fmt_value(q)
+                    lines.append(
+                        f"{self.name}{_fmt_labels(key, quant)}"
+                        f" {_fmt_value(child.quantile(q))}")
             else:
                 lines.append(f"{self.name}{_fmt_labels(key)}"
                              f" {_fmt_value(child.value)}")
@@ -234,7 +243,10 @@ class MetricRegistry:
                 label = ",".join(f"{k}={v}" for k, v in key)
                 if fam.kind == "histogram":
                     entry["series"][label] = {"sum": child.sum,
-                                              "count": child.count}
+                                              "count": child.count,
+                                              "p50": child.quantile(0.5),
+                                              "p95": child.quantile(0.95),
+                                              "p99": child.quantile(0.99)}
                 else:
                     entry["series"][label] = child.value
             out[fam.name] = entry

@@ -1,0 +1,388 @@
+"""Crash-surviving per-request span trail — the serving twin of the
+flight recorder.
+
+The flight recorder (obs/events.py) made *training* restarts
+machine-accountable; the serving stack built since (paged KV, spec/tree
+decode, hot reload, the multi-host fleet) exposed only counters and
+gauges — nobody could say what a single request's TTFT or per-token
+latency was, or where a migrated request spent its time. This module
+closes that gap with a Dapper-style span model: one trace per request,
+keyed by a ``trace_id`` minted at intake and carried through the
+journal, so a request migrated between fleet hosts leaves one joinable
+trail across every process that touched it.
+
+Spans are appended to a line-buffered JSONL file (append mode, flushed +
+fsynced on every exit path) and mirrored into a ring buffer, exactly
+like the flight recorder: a host SIGKILLed mid-decode still leaves every
+span it committed on disk, and the stitcher tolerates the torn tail.
+
+Span schema (``t`` is the span END on the unix wall clock — wall, not
+monotonic, because traces are joined ACROSS hosts):
+
+    {"t": <unix wall clock>, "trace_id": "...", "id": "<request id>",
+     "span": "<stage>", "job": "...", "host": "...",
+     "dur": <seconds|null>, ...payload}
+
+Span names with a fixed meaning across the fleet (payloads free-form):
+
+    intake        request accepted/minted at intake (router or serve)
+    queue         placement/admission wait (dur = seconds queued)
+    placement     router chose a host (payload: host, gen)
+    assign        a fleet host picked the assignment up (payload: gen,
+                  committed tokens to replay)
+    prefill       prompt prefill finished (dur; payload: prompt_tokens,
+                  chunks, packed, replayed)
+    first_token   first token available — the TTFT reference point
+                  (payload: ttft as measured by the serving clock)
+    decode_round  one decode/spec round that committed tokens to this
+                  request (payload: tokens, mode=token|burst|spec|tree)
+    reload_pause  hot weight reload stalled this in-flight request
+                  (dur = swap seconds; payload: old, new)
+    migration     router fenced the dead src and re-admitted on dst
+                  (payload: src, dst, gen, replayed = committed prefix
+                  length the survivor must replay bit-exactly)
+    requeue       drain persisted this request back to the journal
+    done          request finished (payload: reason, tokens, ttft, tpot)
+
+TTFT = first_token.t - intake/submit; TPOT = (done.t - first_token.t) /
+(tokens - 1) — the first token is prefill's, so only the remaining
+tokens price the decode loop (the DistServe/Splitwise framing).
+``scripts/latency_report.py`` stitches trace files from every host into
+per-request critical paths and an SLO-attainment table, the way
+``goodput_report.py`` stitches flight-recorder files into goodput.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+# Spans that mark decode progress: used by the stitcher to find the last
+# token-committing event when a `done` span is missing (crashed host).
+_PROGRESS_SPANS = ("decode_round", "first_token", "prefill")
+
+
+def derive_trace_path(event_log: str) -> str:
+    """Default trace-file path next to a flight-recorder event log
+    (``events_router.jsonl`` -> ``trace_router.jsonl``), so one directory
+    holds both trails and the stitchers can consume it whole."""
+    d, b = os.path.split(event_log)
+    if b.startswith("events_"):
+        b = b[len("events_"):]
+    return os.path.join(d, f"trace_{b}")
+
+
+def mint_trace_id(request_id: str = "") -> str:
+    """Mint a trace id at intake. Prefixed with the request id so trace
+    files stay human-greppable; suffixed with enough randomness that two
+    fleets sharing a journal directory can never collide."""
+    suffix = uuid.uuid4().hex[:12]
+    return f"{request_id}-{suffix}" if request_id else suffix
+
+
+class SpanRecorder:
+    """Append-only JSONL span log + ring buffer of the last ``capacity``."""
+
+    def __init__(self, path: Optional[str] = None, capacity: int = 1024,
+                 job: str = "local", host: str = "0",
+                 clock: Callable[[], float] = time.time):
+        self.path = path
+        self.job = job
+        self.host = str(host)
+        self.clock = clock
+        self.ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a", buffering=1)  # line-buffered
+
+    def emit(self, trace_id: str, request_id: str, span: str,
+             dur: Optional[float] = None, **payload) -> Dict:
+        rec = {"t": self.clock(), "trace_id": str(trace_id),
+               "id": str(request_id), "span": span, "job": self.job,
+               "host": self.host}
+        if dur is not None:
+            rec["dur"] = float(dur)
+        rec.update(payload)
+        with self._lock:
+            self.ring.append(rec)
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(rec) + "\n")
+                except (OSError, ValueError):
+                    pass  # a full/dead disk must never take down serving
+        return rec
+
+    def flush(self) -> None:
+        """Push buffered lines to the OS and fsync — the exit-path call:
+        after this, the spans survive the process."""
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except (OSError, ValueError):
+                pass
+
+    def dump(self, path: str) -> None:
+        """Write the ring buffer to ``path`` (forensics fallback for runs
+        that never configured a write-through file)."""
+        with self._lock:
+            spans = list(self.ring)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as fh:
+            for rec in spans:
+                fh.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# --------------------------------------------------------- module singleton
+# Memory-only until configure() points it at a file; the router, fleet
+# hosts, and serve.py emit through the module functions so spans recorded
+# before setup finishes are not lost.
+_RECORDER = SpanRecorder()
+
+
+def configure(path: Optional[str], job: str = "local", host: str = "0",
+              capacity: int = 1024) -> SpanRecorder:
+    """Swap in a configured recorder; prior ring contents carry over so
+    spans emitted before configuration are not lost."""
+    global _RECORDER
+    old = _RECORDER
+    rec = SpanRecorder(path, capacity=capacity, job=job, host=host)
+    rec.ring.extend(old.ring)
+    if rec._fh is not None:
+        for span in rec.ring:  # replay pre-configuration spans into the file
+            try:
+                rec._fh.write(json.dumps(span) + "\n")
+            except (OSError, ValueError):
+                break
+    old.close()
+    _RECORDER = rec
+    return rec
+
+
+def get() -> SpanRecorder:
+    return _RECORDER
+
+
+def emit(trace_id: str, request_id: str, span: str,
+         dur: Optional[float] = None, **payload) -> Dict:
+    return _RECORDER.emit(trace_id, request_id, span, dur=dur, **payload)
+
+
+def flush() -> None:
+    _RECORDER.flush()
+
+
+def read_spans(path: str) -> List[Dict]:
+    """Load one JSONL trace file; tolerates a truncated final line (the
+    crash case the line-buffered flush exists for)."""
+    spans = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write from a killed process
+            if isinstance(rec, dict) and "trace_id" in rec:
+                spans.append(rec)
+    return spans
+
+
+# ------------------------------------------------------------- stitching
+
+def _trace_files(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            names = sorted(n for n in os.listdir(p)
+                           if n.endswith(".jsonl") and n.startswith("trace"))
+            files.extend(os.path.join(p, n) for n in names)
+        elif os.path.isfile(p):
+            files.append(p)
+    return files
+
+
+def load_traces(paths: Iterable[str]) -> Dict[str, List[Dict]]:
+    """Read span files (or directories of ``trace*.jsonl``) from every
+    host and group them by trace_id, each trace time-sorted — the
+    cross-host join a migrated request's forensics depend on."""
+    traces: Dict[str, List[Dict]] = {}
+    for path in _trace_files(paths):
+        for rec in read_spans(path):
+            traces.setdefault(rec["trace_id"], []).append(rec)
+    for spans in traces.values():
+        spans.sort(key=lambda r: (r.get("t", 0.0), r.get("span", "")))
+    return traces
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile; 0.0 on an empty population."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = max(0, min(len(vs) - 1, int(math.ceil(q * len(vs))) - 1))
+    return vs[idx]
+
+
+def derive(spans: List[Dict]) -> Dict:
+    """Per-request summary of one stitched trace: TTFT/TPOT, hosts
+    visited, migration/replay evidence, and the wall-clock critical path.
+
+    Prefers the serving clock's own measurements (the ``done`` span's
+    ttft/tpot payload, monotonic-clock durations) and falls back to
+    wall-clock span deltas when the request never finished (crashed
+    host) — coarser, but still attributable.
+    """
+    by_name: Dict[str, List[Dict]] = {}
+    for rec in spans:
+        by_name.setdefault(rec.get("span", ""), []).append(rec)
+
+    def first(name):
+        recs = by_name.get(name)
+        return recs[0] if recs else None
+
+    def last(name):
+        recs = by_name.get(name)
+        return recs[-1] if recs else None
+
+    intake, ft, done = first("intake"), first("first_token"), last("done")
+    hosts: List[str] = []
+    for rec in spans:
+        h = str(rec.get("host", ""))
+        if h and h not in hosts:
+            hosts.append(h)
+    migrations = by_name.get("migration", [])
+    replayed = sum(int(m.get("replayed", 0)) for m in migrations)
+
+    ttft = tpot = None
+    tokens = done.get("tokens") if done else None
+    if done is not None and done.get("ttft") is not None:
+        ttft = float(done["ttft"])
+    elif ft is not None and intake is not None:
+        ttft = max(0.0, ft["t"] - intake["t"])
+    if done is not None and done.get("tpot") is not None:
+        tpot = float(done["tpot"])
+    elif ft is not None and done is not None and tokens and tokens > 1:
+        tpot = max(0.0, done["t"] - ft["t"]) / (tokens - 1)
+
+    queue_wait = sum(float(r.get("dur", 0.0)) for r in by_name.get("queue", ()))
+    prefill_s = sum(float(r.get("dur", 0.0)) for r in by_name.get("prefill", ()))
+    stall_s = sum(float(r.get("dur", 0.0))
+                  for r in by_name.get("reload_pause", ()))
+    decode_rounds = len(by_name.get("decode_round", ()))
+
+    # Wall-clock critical path: every span in time order with the host
+    # that emitted it — the "where did this request spend its time" view.
+    path = [{"span": r.get("span"), "host": str(r.get("host", "")),
+             "t": r.get("t"), "dur": r.get("dur")} for r in spans]
+
+    t0 = spans[0]["t"] if spans else None
+    t1 = spans[-1]["t"] if spans else None
+    return {
+        "trace_id": spans[0]["trace_id"] if spans else "",
+        "request_id": spans[0].get("id", "") if spans else "",
+        "hosts": hosts,
+        "migrated": bool(migrations),
+        "migrations": len(migrations),
+        "replayed": replayed,
+        "spans": len(spans),
+        "ttft": ttft,
+        "tpot": tpot,
+        "tokens": tokens,
+        "reason": done.get("reason") if done else None,
+        "done": done is not None,
+        "queue_wait": queue_wait,
+        "prefill_seconds": prefill_s,
+        "reload_stall_seconds": stall_s,
+        "decode_rounds": decode_rounds,
+        "wall_seconds": (t1 - t0) if (t0 is not None and t1 is not None)
+                        else None,
+        "critical_path": path,
+    }
+
+
+def stitch(paths: Iterable[str]) -> List[Dict]:
+    """load_traces + derive, sorted by request id: the machine-readable
+    product of ``scripts/latency_report.py``."""
+    traces = load_traces(paths)
+    reqs = [derive(spans) for spans in traces.values()]
+    reqs.sort(key=lambda r: (r["request_id"], r["trace_id"]))
+    return reqs
+
+
+def format_report(reqs: List[Dict], slo_ttft: Optional[float] = None,
+                  slo_tpot: Optional[float] = None) -> str:
+    """Human latency report: per-request critical-path table, TTFT/TPOT
+    percentiles, and SLO attainment when targets are given."""
+    lines = ["Request latency report"]
+    lines.append(f"requests {len(reqs)} | "
+                 f"migrated {sum(1 for r in reqs if r['migrated'])} | "
+                 f"driver scripts/latency_report.py")
+    lines.append("")
+    lines.append(f"{'request':<10} {'hosts':<12} {'ttft_ms':>9} "
+                 f"{'tpot_ms':>9} {'tokens':>7} {'rounds':>7} "
+                 f"{'replayed':>9} {'stall_ms':>9} {'reason':<10}")
+    lines.append("-" * 88)
+    for r in reqs:
+        ttft = f"{r['ttft'] * 1e3:.1f}" if r["ttft"] is not None else "-"
+        tpot = f"{r['tpot'] * 1e3:.2f}" if r["tpot"] is not None else "-"
+        stall = f"{r['reload_stall_seconds'] * 1e3:.0f}"
+        lines.append(
+            f"{r['request_id']:<10} {'>'.join(r['hosts']):<12} {ttft:>9} "
+            f"{tpot:>9} {str(r['tokens'] if r['tokens'] is not None else '-'):>7} "
+            f"{r['decode_rounds']:>7} {r['replayed']:>9} {stall:>9} "
+            f"{str(r['reason'] or ('-' if r['done'] else 'UNFINISHED')):<10}")
+    lines.append("")
+    ttfts = [r["ttft"] for r in reqs if r["ttft"] is not None]
+    tpots = [r["tpot"] for r in reqs if r["tpot"] is not None]
+    for name, vals in (("ttft", ttfts), ("tpot", tpots)):
+        if vals:
+            lines.append(
+                f"{name}: p50 {percentile(vals, 0.5) * 1e3:.1f} ms | "
+                f"p95 {percentile(vals, 0.95) * 1e3:.1f} ms | "
+                f"p99 {percentile(vals, 0.99) * 1e3:.1f} ms "
+                f"(n={len(vals)})")
+        else:
+            lines.append(f"{name}: no finished requests")
+    if slo_ttft is not None or slo_tpot is not None:
+        ok = total = 0
+        for r in reqs:
+            if r["ttft"] is None and r["tpot"] is None:
+                continue
+            total += 1
+            good = True
+            if slo_ttft is not None and (r["ttft"] is None
+                                         or r["ttft"] > slo_ttft):
+                good = False
+            if slo_tpot is not None and (r["tpot"] is None
+                                         or r["tpot"] > slo_tpot):
+                good = False
+            ok += 1 if good else 0
+        pct = 100.0 * ok / total if total else 0.0
+        slo_bits = []
+        if slo_ttft is not None:
+            slo_bits.append(f"ttft <= {slo_ttft * 1e3:.0f} ms")
+        if slo_tpot is not None:
+            slo_bits.append(f"tpot <= {slo_tpot * 1e3:.1f} ms")
+        lines.append(f"SLO ({' and '.join(slo_bits)}): "
+                     f"{ok}/{total} attained ({pct:.1f}%)")
+    return "\n".join(lines)

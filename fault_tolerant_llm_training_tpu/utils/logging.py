@@ -128,3 +128,11 @@ AUDIT_FLEET_MIGRATE_FMT = ("[FLEET] Migrating request {id}: {src} -> {dst} "
 AUDIT_FLEET_REQUEUE_FMT = ("[FLEET] Requeued request {id} to the journal "
                            "({committed} committed token(s), reason "
                            "{reason})")
+
+# --- Request-latency audit trail (inference/serve.py, inference/fleet.py) —
+# the drain summary prints one per-request latency verdict so operators
+# (and scripts/chaos_campaign.py) can grep TTFT/TPOT off the .out file;
+# obs/reqtrace.py holds the machine-readable span trail behind it. ---
+AUDIT_LATENCY_FMT = ("[LATENCY] Request {id} | trace {trace} | ttft "
+                     "{ttft_ms:.0f} ms | tpot {tpot_ms:.2f} ms | "
+                     "{tokens} tok | {reason}")

@@ -78,6 +78,7 @@ from fault_tolerant_llm_training_tpu.obs.goodput import (  # noqa: E402
     load_chain,
     stitch,
 )
+from fault_tolerant_llm_training_tpu.obs import reqtrace  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
@@ -724,6 +725,26 @@ def run_fleet_scenario(work: str, parquet: str, seed: int) -> Result:
             fleet_outputs.get(f"req{i}") == ref_outputs.get(f"req{i}")
             for i in range(4)),
         "migrated streams bit-identical to the unfailed reference serve")
+
+    # 6. request-trace stitch (obs/reqtrace.py): every process wrote a
+    # trace_<name>.jsonl next to its event log; joined by trace_id, the
+    # migrated request must show ONE trail that spans both hosts, and its
+    # migration span's replayed count must equal the journal committed
+    # prefix the router logged
+    migr_by_id = {rid: int(n) for rid, n in re.findall(
+        r"\[FLEET\] Migrating request (req\d+): h0 -> h1 \(gen \d+, (\d+) "
+        r"committed token\(s\) replayed\)", rout)}
+    traced = {r["request_id"]: r
+              for r in reqtrace.stitch([base]) if r["request_id"]}
+    trace_ok = bool(migr_by_id)
+    for rid, committed in migr_by_id.items():
+        tr = traced.get(rid)
+        trace_ok = (trace_ok and tr is not None and tr["migrated"]
+                    and {"h0", "h1"} <= set(tr["hosts"])
+                    and tr["replayed"] == committed)
+    res.check(trace_ok,
+              "stitched trace: migrated request spans h0 and h1, replay "
+              "count matches the journal committed prefix")
     _stitch_scenario(res, events_dir)
     return res
 

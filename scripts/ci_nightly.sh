@@ -40,7 +40,14 @@
 #    greedy streams bit-match non-spec decode, and every point drained
 #    through the strict block leak guard (acceptance magnitudes are
 #    draft-noise-seeded and machine-independent only in sign, so the
-#    gain bar — not its value — is pinned).
+#    gain bar — not its value — is pinned);
+# 7. serving_load bench — re-runs the trace-driven load harness (seeded
+#    poisson + bursty arrivals, spec off/on) and pins the
+#    BENCH_serving_latency_cpu.json bars: zero dropped requests, every
+#    point completes all 24, per-point generated-token counts equal the
+#    receipt exactly (tick-based arrivals make the load deterministic),
+#    and p99 TTFT/TPOT stay under loose absolute ceilings (latency
+#    magnitudes are machine-dependent and not pinned).
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -107,7 +114,8 @@ for want in \
     "ok: zero requests lost: all 4 served" \
     "ok: heartbeat-delayed h1 stayed under its ttl (no false dead verdict)" \
     "ok: survivor drained leak-clean and exited 0 (got rc 0)" \
-    "ok: migrated streams bit-identical to the unfailed reference serve"
+    "ok: migrated streams bit-identical to the unfailed reference serve" \
+    "ok: stitched trace: migrated request spans h0 and h1, replay count matches the journal committed prefix"
 do
     if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
         echo "FAIL: fleet drill check missing from report: $want"
@@ -226,4 +234,35 @@ print(f"ok: tree {got['best_shape']} {got['value']}x linear accepted/"
       f"all drains leak-clean")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, prefix bench, fused decode, packed prefill, tree spec)"
+echo "== serving_load bench vs committed receipt"
+python scripts/decode_bench.py --scenario serving_load --vocab-size 64 \
+    --requests 24 --out "$WORK/bench_serving.json"
+python - "$WORK/bench_serving.json" BENCH_serving_latency_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+TTFT_CEIL_MS, TPOT_CEIL_MS = 2000.0, 200.0
+assert got["dropped_total"] == 0, (
+    f"load harness dropped {got['dropped_total']} request(s)")
+want_pts = {(p["process"], p["spec"]): p for p in want["points"]}
+for p in got["points"]:
+    key = (p["process"], p["spec"])
+    w = want_pts[key]
+    assert p["requests_completed"] == 24, (
+        f"{key}: only {p['requests_completed']}/24 requests completed")
+    assert p["tokens_generated"] == w["tokens_generated"], (
+        f"{key}: tick-seeded load is deterministic: generated "
+        f"{p['tokens_generated']} tokens, receipt {w['tokens_generated']}")
+    assert p["ttft_p99_ms"] <= TTFT_CEIL_MS, (
+        f"{key}: p99 TTFT {p['ttft_p99_ms']} ms > {TTFT_CEIL_MS} ms ceiling")
+    assert p["tpot_p99_ms"] <= TPOT_CEIL_MS, (
+        f"{key}: p99 TPOT {p['tpot_p99_ms']} ms > {TPOT_CEIL_MS} ms ceiling")
+worst = max(p["ttft_p99_ms"] for p in got["points"])
+print(f"ok: serving load 4/4 points completed 24/24 (0 dropped), token "
+      f"counts match receipt, worst p99 TTFT {worst} ms (<= "
+      f"{TTFT_CEIL_MS:.0f} ms), p99 TPOT under {TPOT_CEIL_MS:.0f} ms")
+EOF
+
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, prefix bench, fused decode, packed prefill, tree spec, serving latency)"

@@ -1,0 +1,69 @@
+"""Latency report: stitch request-trace span logs across the serving fleet.
+
+The serving twin of ``goodput_report.py``: every serving process (router,
+fleet hosts, ``serve.py``) writes a crash-surviving span trail
+(``trace_<name>.jsonl`` next to its ``--event-log``, obs/reqtrace.py); this
+tool joins the trails by ``trace_id`` — so a request migrated between hosts
+becomes ONE critical path — and prints per-request TTFT/TPOT, the hosts
+each request visited, replayed-token counts, and p50/p95/p99 percentiles,
+plus an SLO-attainment table when targets are given.
+
+Usage:
+    python scripts/latency_report.py <trace-dir-or-file> [more paths...]
+    python scripts/latency_report.py run/ --slo-ttft-ms 500 --slo-tpot-ms 50
+    python scripts/latency_report.py 'run/trace_*.jsonl' --json
+
+Paths may be JSONL files, directories (all ``trace*.jsonl`` inside), or
+globs; all spans are pooled and grouped per trace id before stitching.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fault_tolerant_llm_training_tpu.obs.reqtrace import (  # noqa: E402
+    format_report,
+    stitch,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("paths", nargs="+",
+                   help="trace files, directories, or globs")
+    p.add_argument("--json", action="store_true",
+                   help="emit per-request records as JSON instead of the "
+                        "table")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms; adds the attainment line")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   help="TPOT SLO target in ms; adds the attainment line")
+    args = p.parse_args(argv)
+
+    paths = []
+    for raw in args.paths:
+        hits = glob.glob(raw)
+        paths.extend(hits if hits else [raw])
+    reqs = stitch(paths)
+    if not reqs:
+        print(f"no trace spans found under: {', '.join(args.paths)}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(reqs, indent=2))
+    else:
+        print(format_report(
+            reqs,
+            slo_ttft=(args.slo_ttft_ms / 1e3
+                      if args.slo_ttft_ms is not None else None),
+            slo_tpot=(args.slo_tpot_ms / 1e3
+                      if args.slo_tpot_ms is not None else None)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,9 @@ FROZEN = {
     "AUDIT_FLEET_REQUEUE_FMT":
         "[FLEET] Requeued request {id} to the journal ({committed} "
         "committed token(s), reason {reason})",
+    "AUDIT_LATENCY_FMT":
+        "[LATENCY] Request {id} | trace {trace} | ttft {ttft_ms:.0f} ms "
+        "| tpot {tpot_ms:.2f} ms | {tokens} tok | {reason}",
 }
 
 
