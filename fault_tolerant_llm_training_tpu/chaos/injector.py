@@ -22,7 +22,13 @@ exposes one hook per injection site:
   the publish;
 - :meth:`on_reload` — deploy/reload.py, keyed by reload ordinal (1 = the
   first swap): ``reload_signal`` delivers a real SIGUSR1 in the middle of
-  a hot weight swap.
+  a hot weight swap;
+- :meth:`on_handoff` / :meth:`on_spill` — the tiered-KV block artifacts
+  (inference/scheduler.py spill tier, inference/fleet.py ``--handoff``
+  drain), keyed by export ordinal: ``handoff_corrupt`` / ``spill_corrupt``
+  flip one payload byte AFTER the artifact's CRC manifest commits, so the
+  verify-before-import must reject it and the request must degrade to
+  committed-prefix replay.
 
 Trigger kinds beyond ``step=N`` (chaos/schedule.py): ``t=DUR`` entries
 fire at the first injection-site visit after DUR has elapsed since this
@@ -260,6 +266,43 @@ class ChaosInjector:
             self._fire(e, at_step=ordinal, signum=int(_signal.SIGUSR1),
                        reload=True)
             ft_signals.inject(_signal.SIGUSR1)
+
+    def _corrupt_artifact(self, fault: str, artifact_dir: str,
+                          ordinal: int, what: str) -> Optional[str]:
+        """Shared body for the block-artifact corruption hooks: flip one
+        seeded payload byte in ``artifact_dir`` — ``_flip_byte`` spares
+        ``integrity.json``, so the damage lands exactly where the CRC
+        manifest must catch it. Keyed by export ordinal (0 = first)."""
+        corrupted = None
+        for e in self._pending((fault,), ordinal):
+            self._fire(e, at_step=ordinal, phase="corrupt")
+            flipped = self._flip_byte(artifact_dir, logger, what=what)
+            if flipped is not None:
+                corrupted, rel, offset = flipped
+                events.emit(kind=f"chaos_{fault}", step=int(ordinal),
+                            phase="corrupted", file=rel, offset=offset)
+                events.flush()
+        return corrupted
+
+    def on_handoff(self, artifact_dir: str,
+                   ordinal: int = 0) -> Optional[str]:
+        """Drain-time block-shipment hook (inference/fleet.py
+        ``--handoff``), called AFTER one request's artifact manifest
+        commits: ``handoff_corrupt`` — the router/survivor CRC verify
+        must reject the artifact and the migration must degrade to
+        committed-prefix replay. Returns the corrupted path."""
+        return self._corrupt_artifact(
+            "handoff_corrupt", artifact_dir, ordinal,
+            what=f"handoff artifact {ordinal}")
+
+    def on_spill(self, artifact_dir: str, ordinal: int = 0) -> Optional[str]:
+        """Spill-tier hook (inference/scheduler.py), called AFTER a
+        preempted request's artifact manifest commits: ``spill_corrupt``
+        — the restore's CRC verify must reject the artifact and fall
+        back to a replay re-admission. Returns the corrupted path."""
+        return self._corrupt_artifact(
+            "spill_corrupt", artifact_dir, ordinal,
+            what=f"spill artifact {ordinal}")
 
     def post_fault_save(self, checkpoint_dir: str, saved_step: int,
                         log) -> Optional[str]:

@@ -62,6 +62,14 @@ heartbeat_delay sleep inside the fleet host's lease-renewal path (arg =
                 duration, default 2s) — a slow-but-alive host: shorter
                 than the ttl it must NOT trip the dead verdict; longer, it
                 must self-fence rather than double-commit
+handoff_corrupt flip a payload byte in a just-exported block-shipment
+                artifact (inference/kv_cache.py), keyed by export ordinal
+                (0 = first handoff export) — the router/survivor CRC
+                verify must reject the artifact and the migration must
+                degrade to committed-prefix replay with nothing lost
+spill_corrupt   flip a payload byte in a just-written KV spill artifact,
+                keyed by spill ordinal — the restore's CRC verify must
+                reject it and fall back to a replay re-admission
 ==============  ============================================================
 
 Steps are *global* training steps, so an entry in the past at resume time
@@ -88,17 +96,20 @@ FAULTS = {
     "reload_signal": None,
     "host_kill": None,
     "heartbeat_delay": 2.0,
+    "handoff_corrupt": None,
+    "spill_corrupt": None,
 }
 
 # The serving loop has no training steps, prefetcher or KV agreement: only
-# the signal faults (a mid-decode drain) and the mid-swap reload signal
-# make sense there.
-SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal")
+# the signal faults (a mid-decode drain), the mid-swap reload signal and
+# the spill-tier corruption make sense there.
+SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal", "spill_corrupt")
 
 # A fleet host adds the membership faults; "one rank" is expressed by
 # giving only that host's process the entry (each host is a separate OS
 # process with its own schedule, so @rank= is unnecessary there).
-FLEET_FAULTS = ("sigusr1", "sigterm", "host_kill", "heartbeat_delay")
+FLEET_FAULTS = ("sigusr1", "sigterm", "host_kill", "heartbeat_delay",
+                "handoff_corrupt", "spill_corrupt")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ENTRY_RE = re.compile(

@@ -115,6 +115,8 @@ from .kv_cache import (
     blocks_per_slot,
     cache_shardings,
     copy_kv_block,
+    export_blocks,
+    import_blocks,
     init_cache,
     init_paged_cache,
     remap_paged_path,
@@ -1193,6 +1195,34 @@ class InferenceEngine:
             raise ValueError("copy-on-write requires the paged KV layout")
         self.cache = self._cow(self.cache, np.int32(src_block),
                                np.int32(dst_block))
+
+    def export_slot_blocks(self, blocks, out_dir: str, *, slot: int,
+                           meta=None) -> dict:
+        """Serialize pool rows ``blocks`` (the slot's committed KV, in
+        block-table order) into a checksummed artifact directory — the
+        device side of spill and handoff. ``length`` is captured from the
+        live cache so the restore resumes the decode position exactly.
+        Returns the artifact manifest."""
+        if self.kv_layout != "paged":
+            raise ValueError("block export requires the paged KV layout")
+        length = int(np.asarray(self.cache.lengths)[slot])
+        return export_blocks(self.cache, blocks, out_dir,
+                             length=length, meta=meta)
+
+    def import_slot_blocks(self, art_dir: str, dest_blocks,
+                           slot: int) -> dict:
+        """Verify artifact ``art_dir`` (CRC of every payload BEFORE any
+        device write) and scatter it into pool rows ``dest_blocks``, then
+        restore ``slot``'s fill count from the manifest's recorded length.
+        Raises ``KVBlockIntegrityError`` with the cache untouched on any
+        mismatch. Returns the manifest."""
+        if self.kv_layout != "paged":
+            raise ValueError("block import requires the paged KV layout")
+        cache, manifest = import_blocks(self.cache, art_dir, dest_blocks)
+        self.cache = cache.replace(
+            lengths=cache.lengths.at[slot].set(
+                np.int32(manifest["length"])))
+        return manifest
 
     def _stream_chunks(self, draft: bool, row, ids, slot, temperature,
                        top_p, seed, stop_check, on_chunk, start_pos=0):

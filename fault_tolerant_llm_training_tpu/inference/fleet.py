@@ -30,6 +30,16 @@ Death and fencing (the split-brain contract, ft/lease.py docstring):
   persists anything still queued as ``requeue`` records and runs the
   KV-block leak guard — the campaign pins "Fleet drain leak guard:
   clean" on every survivor.
+
+With ``--handoff`` a signal drain SHIPS its in-flight requests instead of
+finishing them: each active slot's committed KV blocks are exported as a
+checksummed artifact next to the journal (scheduler ``export_handoff``), a
+``handoff`` journal record points at it, and the request is requeued with
+its committed baseline. The router then migrates by block import on the
+survivor when the artifact CRC-verifies, and by the ordinary
+committed-prefix replay when it is missing, torn, or rejected — a SIGKILL
+leaves no artifact and naturally takes the replay path, so the handoff
+fast path adds no new way to lose a request.
 """
 
 import argparse
@@ -41,6 +51,7 @@ import time
 from ..chaos import FLEET_FAULTS, ChaosInjector, parse_schedule
 from ..data.tokenizer import load_tokenizer
 from ..ft.lease import FileKVStore, LeaseRegistry
+from ..ft.retry import RetryDeadlineExceeded, retry_with_backoff
 from ..ft.signals import SignalFlag
 from ..models.configs import get_config
 from ..obs import events, reqtrace
@@ -71,21 +82,34 @@ class _AssignmentFollower:
     host. Byte-offset tracking, complete (newline-terminated) lines only —
     the same torn-read discipline as serve.py's request follower."""
 
-    def __init__(self, journal_dir: str, host_id: str):
+    def __init__(self, journal_dir: str, host_id: str,
+                 read_deadline: float = 0.5):
         self.path = os.path.join(journal_dir, ROUTER_JOURNAL)
         self.host_id = host_id
         self.offset = 0
+        self.read_deadline = read_deadline
+
+    def _read_tail(self) -> bytes:
+        with open(self.path, "rb") as fh:
+            fh.seek(self.offset)
+            return fh.read()
 
     def poll(self):
         try:
             size = os.path.getsize(self.path)
         except OSError:
-            return []
+            return []  # router not started yet — normal, don't retry
         if size <= self.offset:
             return []
-        with open(self.path, "rb") as fh:
-            fh.seek(self.offset)
-            data = fh.read()
+        try:
+            # the file exists and has new bytes: a read failure here is
+            # transient (ft/retry.py backoff), not a missing journal
+            data = retry_with_backoff(self._read_tail,
+                                      deadline_seconds=self.read_deadline,
+                                      retry_on=(OSError,),
+                                      what="router journal read")
+        except RetryDeadlineExceeded:
+            return []  # next poll re-reads from the same offset
         end = data.rfind(b"\n")
         if end < 0:
             return []
@@ -152,7 +176,20 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
     p.add_argument("--chaos", default="",
                    help="fault schedule: host_kill / sigusr1 / sigterm "
                         "keyed by decode iteration (serve.py convention); "
-                        "heartbeat_delay keyed by fleet loop iteration")
+                        "heartbeat_delay keyed by fleet loop iteration; "
+                        "handoff_corrupt / spill_corrupt keyed by export "
+                        "ordinal")
+    p.add_argument("--handoff", action="store_true",
+                   help="on a signal drain, ship in-flight requests' "
+                        "committed KV blocks as checksummed artifacts "
+                        "(journal 'handoff' records) instead of finishing "
+                        "them; survivors import the blocks, or replay the "
+                        "committed prefix if the artifact fails CRC")
+    p.add_argument("--spill-dir", default="",
+                   help="enable the scheduler's spill tier: on pool "
+                        "exhaustion, preempt the coldest request's blocks "
+                        "into checksummed artifacts under this directory "
+                        "and restore on demand")
     return p.parse_args(argv)
 
 
@@ -208,7 +245,10 @@ def main(argv=None) -> None:
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id),
-                          stop_check=lambda: flag.signum is not None)
+                          stop_check=lambda: flag.signum is not None,
+                          spill_dir=args.spill_dir or None,
+                          on_spill=(chaos.on_spill if chaos is not None
+                                    else None))
 
     store = FileKVStore(args.store)
     lease = LeaseRegistry(store, host_id=args.host_id,
@@ -301,7 +341,12 @@ def main(argv=None) -> None:
                     top_p=float(rec.get("top_p", 1.0)),
                     seed=int(rec.get("seed", 0)),
                     committed=tuple(committed),
-                    trace_id=trace_id))
+                    trace_id=trace_id),
+                    # router-verified block-shipment artifact (if any):
+                    # admission imports the blocks; any failure falls back
+                    # to the committed-prefix replay
+                    handoff_artifact=str(rec.get("handoff", "") or ""),
+                    handoff_gen=gen)
             except ValueError as e:
                 logger.warning(f"[FLEET] rejecting assignment {rid}: {e}")
                 continue
@@ -351,16 +396,45 @@ def main(argv=None) -> None:
         "drain", phase="begin", signum=flag.signum,
         active=len(sched.active))
     sched.stop_admission()
-    while sched.active or sched._pending_prefill:
-        sched.step()
-        emit_completions()
-        for st in sched.active.values():
-            journal.progress(st.request.id, args.host_id, st.tokens,
-                             gen=gens.get(st.request.id, 0),
-                             trace_id=st.request.trace_id)
+    if args.handoff and (sched.active or sched._pending_prefill):
+        # Block-shipment drain: instead of finishing in-flight requests,
+        # export each active slot's committed blocks as a checksummed
+        # artifact next to the journal and record a `handoff` pointer.
+        # Mid-prefill rows have no committed KV worth shipping — requeue
+        # them first, the ordinary way. The artifact is written and
+        # fsynced BEFORE its journal record, so a record always names a
+        # complete artifact.
+        if sched._pending_prefill:
+            sched._abort_pending_prefill()
+        n_handoff = 0
+        for slot in sorted(sched.active):
+            st = sched.active[slot]
+            rid = st.request.id
+            gen = gens.get(rid, 0)
+            art = os.path.join(args.journal_dir,
+                               f"handoff_{rid}_g{gen}")
+            info = sched.export_handoff(slot, art, gen=gen)
+            if chaos is not None:
+                # handoff_corrupt: seeded byte flip in a payload (the
+                # manifest is spared), keyed by export ordinal — the
+                # survivor's CRC verify must reject it and replay
+                chaos.on_handoff(art, n_handoff)
+            journal.handoff(rid, args.host_id, art, info["tokens"],
+                            gen=gen, trace_id=st.request.trace_id)
+            n_handoff += 1
+    else:
+        while sched.active or sched._pending_prefill:
+            sched.step()
+            emit_completions()
+            for st in sched.active.values():
+                journal.progress(st.request.id, args.host_id, st.tokens,
+                                 gen=gens.get(st.request.id, 0),
+                                 trace_id=st.request.trace_id)
     emit_completions()
     persist_unserved(journal, sched.unserved(), reason=exit_reason,
                      gens=gens)
+    if sched.enable_spill:
+        sched.discard_spilled()
     leaks = sched.audit_block_leaks(strict=False)
     if not leaks:
         logger.info("Fleet drain leak guard: clean")

@@ -21,6 +21,14 @@ Record kinds:
   (queued, mid-prefill, or in-flight) for later re-admission — the same
   record serves single-host ``serve.py --journal-dir`` drains and fleet
   drains, unifying both on one code path.
+- ``handoff``  a draining host exported an in-flight request's committed
+  KV blocks as a checksummed artifact (inference/kv_cache.py
+  ``export_blocks``) next to the journal. Advisory, NOT ownership: the
+  paired ``requeue`` still carries the durable committed baseline, the
+  handoff record only tells the router an artifact exists so the
+  re-admission can ship blocks instead of replaying the prefix — a
+  missing/torn/CRC-rejected artifact degrades to the replay with nothing
+  lost.
 
 :func:`fold` reduces all files to per-request state. Resolution leans on
 the fleet's determinism contract: committed lists written for the same
@@ -55,6 +63,8 @@ class RequestState:
     migrations: int = 0
     requeued: bool = False         # latest ownership record is a requeue
     trace_id: str = ""             # obs/reqtrace.py span-trail key
+    handoff_artifact: str = ""     # newest exported block-artifact dir
+    handoff_gen: int = -1          # generation that exported it
 
 
 class RequestJournal:
@@ -104,15 +114,34 @@ class RequestJournal:
     def migrate(self, request_id: str, src: str, dst: str, gen: int,
                 prompt: List[int], max_new_tokens: int, temperature: float,
                 top_p: float, seed: int, committed: List[int],
+                trace_id: str = "", handoff: str = "") -> None:
+        rec = {"kind": "migrate", "id": request_id, "src": src,
+               "host": dst, "gen": int(gen),
+               "prompt": [int(t) for t in prompt],
+               "max_new_tokens": int(max_new_tokens),
+               "temperature": float(temperature),
+               "top_p": float(top_p), "seed": int(seed),
+               "committed": [int(t) for t in committed],
+               "trace_id": str(trace_id)}
+        if handoff:
+            # router-verified block artifact for the destination host to
+            # import instead of replaying the committed prefix (advisory —
+            # the committed list above remains the durable baseline)
+            rec["handoff"] = str(handoff)
+        self._append(rec)
+
+    def handoff(self, request_id: str, host: str, artifact: str,
+                committed: List[int], gen: int,
                 trace_id: str = "") -> None:
-        self._append({"kind": "migrate", "id": request_id, "src": src,
-                      "host": dst, "gen": int(gen),
-                      "prompt": [int(t) for t in prompt],
-                      "max_new_tokens": int(max_new_tokens),
-                      "temperature": float(temperature),
-                      "top_p": float(top_p), "seed": int(seed),
+        """A drain exported this request's committed KV blocks into the
+        ``artifact`` directory. Written AFTER the artifact's manifest
+        commit (fsync ordering), so a handoff record always points at a
+        complete artifact — a host killed mid-export leaves no record and
+        the request takes the replay path."""
+        self._append({"kind": "handoff", "id": request_id, "host": host,
+                      "artifact": str(artifact),
                       "committed": [int(t) for t in committed],
-                      "trace_id": str(trace_id)})
+                      "gen": int(gen), "trace_id": str(trace_id)})
 
     def requeue(self, request_id: str, prompt: List[int],
                 max_new_tokens: int, temperature: float, top_p: float,
@@ -216,6 +245,10 @@ def fold(root: str) -> Dict[str, RequestState]:
             st.temperature = float(rec.get("temperature", st.temperature))
             st.top_p = float(rec.get("top_p", st.top_p))
             st.seed = int(rec.get("seed", st.seed))
+        if kind == "handoff" and gen >= st.handoff_gen:
+            # advisory block-shipment pointer; never touches ownership
+            st.handoff_gen = gen
+            st.handoff_artifact = str(rec.get("artifact", ""))
         committed = rec.get("committed") if kind != "done" else rec.get("tokens")
         if committed is not None:
             committed = [int(t) for t in committed]

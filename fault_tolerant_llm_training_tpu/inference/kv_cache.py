@@ -46,10 +46,14 @@ ring buffer and of the block pool alike); slots/blocks/positions stay
 replicated.
 """
 
-from typing import Optional, Tuple
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -224,6 +228,225 @@ def write_slot_kv(buf: jax.Array, new: jax.Array,
     return jax.vmap(
         lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0)))(
         buf, new, start)
+
+
+# ---------------------------------------------------------------------------
+# Block export / import — the tiered-KV block-move primitive.
+#
+# A block artifact is a DIRECTORY: one payload file per exported pool block
+# (``block_00000.bin`` = that row's bytes across every layer, K then V per
+# layer) plus an ``integrity.json`` manifest recording geometry, the slot's
+# committed KV length, per-file size + CRC32, and caller metadata (request
+# id, committed tokens, row positions). The manifest is written atomic
+# tmp+fsync+rename exactly like checkpoint/manager.py's checkpoint
+# manifests, and import verifies every payload's size and CRC BEFORE any
+# device write — a flipped byte, truncated file, or swapped manifest raises
+# :class:`KVBlockIntegrityError` and the device pool is untouched, so every
+# consumer (spill restore, handoff import) can fall back to the bit-exact
+# committed-prefix replay instead of decoding garbage. The manifest file
+# deliberately reuses the checkpoint manifest's name: the chaos injector's
+# byte-flipper spares ``integrity.json``, so injected corruption always
+# lands in a payload where the CRC must catch it.
+# ---------------------------------------------------------------------------
+
+BLOCK_MANIFEST_NAME = "integrity.json"
+_BLOCK_ARTIFACT_VERSION = 1
+
+
+class KVBlockIntegrityError(RuntimeError):
+    """A KV block artifact failed verification (missing/torn manifest,
+    size or CRC32 mismatch, or geometry that does not fit the live pool).
+    Raised BEFORE any device write, so the pool is never half-imported."""
+
+
+def _fsync_dir(path: str) -> None:
+    """Flush directory metadata so a rename survives power loss (same
+    best-effort semantics as checkpoint/manager.py)."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _block_file_name(i: int) -> str:
+    return f"block_{i:05d}.bin"
+
+
+def _cache_geometry(cache: PagedKVCache) -> Dict[str, object]:
+    return {
+        "n_layers": len(cache.k),
+        "kv_heads": int(cache.k[0].shape[1]),
+        "block_size": int(cache.block_size),
+        "head_dim": int(cache.k[0].shape[3]),
+        "dtype": str(np.dtype(cache.k[0].dtype)
+                     if not hasattr(cache.k[0].dtype, "name")
+                     else cache.k[0].dtype.name),
+    }
+
+
+def export_blocks(cache: PagedKVCache, blocks: Sequence[int], out_dir: str,
+                  *, length: int, meta: Optional[Dict] = None) -> Dict:
+    """Serialize pool rows ``blocks`` device->host into artifact ``out_dir``.
+
+    Payload file i holds block ``blocks[i]``'s bytes for every layer
+    (layer-major, K before V). ``length`` is the slot's committed KV fill
+    count (``cache.lengths[slot]`` at export) so import can restore the
+    decode position exactly; ``meta`` is caller context carried verbatim
+    (request id, committed tokens, row positions). Payloads are flushed and
+    fsynced before the manifest commits via tmp+fsync+rename, so a torn
+    artifact is detectable as missing-manifest, never as silent garbage.
+    Returns the manifest dict."""
+    if 0 in blocks:
+        raise ValueError("refusing to export reserved null block 0")
+    os.makedirs(out_dir, exist_ok=True)
+    idx = np.asarray(list(blocks), np.int32)
+    # One device->host gather per layer per pool, not per block.
+    k_host = [np.asarray(layer[idx]) for layer in cache.k]
+    v_host = [np.asarray(layer[idx]) for layer in cache.v]
+    files: Dict[str, Dict[str, int]] = {}
+    for j in range(len(idx)):
+        payload = b"".join(
+            k_host[layer][j].tobytes() + v_host[layer][j].tobytes()
+            for layer in range(len(k_host)))
+        name = _block_file_name(j)
+        path = os.path.join(out_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        files[name] = {"size": len(payload),
+                       "crc32": zlib.crc32(payload) & 0xFFFFFFFF}
+    manifest = {
+        "version": _BLOCK_ARTIFACT_VERSION,
+        "geometry": _cache_geometry(cache),
+        "blocks": [int(b) for b in blocks],
+        "length": int(length),
+        "files": files,
+        "meta": dict(meta or {}),
+    }
+    man_path = os.path.join(out_dir, BLOCK_MANIFEST_NAME)
+    tmp = man_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, man_path)
+    _fsync_dir(out_dir)
+    return manifest
+
+
+def verify_block_artifact(art_dir: str) -> Dict:
+    """Read and CRC-verify a block artifact; returns the manifest.
+
+    Checks, in order: manifest present and parseable, every payload file
+    present, size match, CRC32 match. Any failure raises
+    :class:`KVBlockIntegrityError` with the failing file named. No device
+    state is involved — the router uses this to decide ship-vs-replay
+    before a survivor ever sees the artifact."""
+    man_path = os.path.join(art_dir, BLOCK_MANIFEST_NAME)
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise KVBlockIntegrityError(
+            f"block artifact manifest unreadable: {man_path}: {e}") from e
+    files = manifest.get("files", {})
+    if len(files) != len(manifest.get("blocks", [])):
+        raise KVBlockIntegrityError(
+            f"block artifact manifest torn: {len(files)} file(s) for "
+            f"{len(manifest.get('blocks', []))} block(s)")
+    for name in sorted(files):
+        want = files[name]
+        path = os.path.join(art_dir, name)
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError as e:
+            raise KVBlockIntegrityError(
+                f"block payload missing: {name}: {e}") from e
+        if len(payload) != int(want["size"]):
+            raise KVBlockIntegrityError(
+                f"block payload size mismatch: {name}: "
+                f"{len(payload)} != {want['size']}")
+        got = zlib.crc32(payload) & 0xFFFFFFFF
+        if got != int(want["crc32"]):
+            raise KVBlockIntegrityError(
+                f"block payload CRC mismatch: {name}: "
+                f"{got:#010x} != {int(want['crc32']):#010x}")
+    return manifest
+
+
+def import_blocks(cache: PagedKVCache, art_dir: str,
+                  dest_blocks: Sequence[int]
+                  ) -> Tuple[PagedKVCache, Dict]:
+    """Verify artifact ``art_dir`` and scatter its payloads into pool rows
+    ``dest_blocks`` (payload i -> ``dest_blocks[i]``). ALL verification —
+    CRC of every payload AND geometry vs the live pool — happens before the
+    first device write; on any mismatch :class:`KVBlockIntegrityError` is
+    raised and ``cache`` is returned unmodified by the caller's contract.
+    ``lengths`` is NOT touched here (the destination slot differs between
+    spill-restore and handoff-import); callers set it from the manifest's
+    ``length``. Returns ``(new_cache, manifest)``."""
+    manifest = verify_block_artifact(art_dir)
+    geo = manifest["geometry"]
+    live = _cache_geometry(cache)
+    if geo != live:
+        raise KVBlockIntegrityError(
+            f"block artifact geometry {geo} does not fit pool {live}")
+    n = len(manifest["blocks"])
+    if len(dest_blocks) != n:
+        raise ValueError(
+            f"artifact has {n} block(s) but {len(dest_blocks)} destination "
+            f"row(s) given")
+    if 0 in dest_blocks:
+        raise ValueError("refusing to import into reserved null block 0")
+    n_layers = len(cache.k)
+    kv_heads = int(cache.k[0].shape[1])
+    bs = int(cache.block_size)
+    hd = int(cache.k[0].shape[3])
+    np_dtype = np.dtype(cache.k[0].dtype.name
+                        if hasattr(cache.k[0].dtype, "name")
+                        else cache.k[0].dtype)
+    per_buf = kv_heads * bs * hd * np_dtype.itemsize
+    k_host = [np.empty((n, kv_heads, bs, hd), np_dtype)
+              for _ in range(n_layers)]
+    v_host = [np.empty((n, kv_heads, bs, hd), np_dtype)
+              for _ in range(n_layers)]
+    for j in range(n):
+        with open(os.path.join(art_dir, _block_file_name(j)), "rb") as f:
+            payload = f.read()
+        if len(payload) != 2 * n_layers * per_buf:
+            raise KVBlockIntegrityError(
+                f"block payload {j} has {len(payload)} byte(s), geometry "
+                f"needs {2 * n_layers * per_buf}")
+        for layer in range(n_layers):
+            off = layer * 2 * per_buf
+            k_host[layer][j] = np.frombuffer(
+                payload[off:off + per_buf], np_dtype).reshape(kv_heads, bs, hd)
+            v_host[layer][j] = np.frombuffer(
+                payload[off + per_buf:off + 2 * per_buf],
+                np_dtype).reshape(kv_heads, bs, hd)
+    idx = jnp.asarray(np.asarray(list(dest_blocks), np.int32))
+    # Import is rare (restore/handoff, not per token), so plain .at[].set
+    # per layer is fine — no AOT program, no donation games.
+    new_k = tuple(cache.k[layer].at[idx].set(jnp.asarray(k_host[layer]))
+                  for layer in range(n_layers))
+    new_v = tuple(cache.v[layer].at[idx].set(jnp.asarray(v_host[layer]))
+                  for layer in range(n_layers))
+    return cache.replace(k=new_k, v=new_v), manifest
+
+
+def artifact_bytes(manifest: Dict) -> int:
+    """Total payload bytes recorded in a block-artifact manifest."""
+    return sum(int(f["size"]) for f in manifest.get("files", {}).values())
 
 
 def cache_pspec() -> P:

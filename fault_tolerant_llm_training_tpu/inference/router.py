@@ -17,7 +17,12 @@ Responsibilities, once per loop:
    carrying the committed token baseline (prompt + committed replay on
    the survivor continues the stream bit-exactly — scheduler.py);
 3. adopt ``requeue`` records that draining hosts (or a single-host
-   ``serve.py --journal-dir`` drain) persisted;
+   ``serve.py --journal-dir`` drain) persisted; when the drain also left
+   a ``handoff`` record (``--handoff`` block shipment), the router
+   CRC-verifies the artifact (ft/retry.py backoff around the reads) and
+   names it in the ``migrate`` record so the survivor imports blocks
+   instead of replaying — a torn or corrupt artifact is rejected here
+   and the migration silently degrades to committed-prefix replay;
 4. assign queued requests to the live host with the most estimated free
    KV blocks (lease capacity metadata, decremented locally per
    assignment so a burst between heartbeats doesn't dogpile one host —
@@ -31,7 +36,8 @@ host finds every request already owned by a survivor and migrates
 nothing.
 
 /metrics (when --metrics-port is set): ``fleet_hosts_live``,
-``requests_migrated_total``, ``fleet_lease_age_seconds{host=...}``.
+``requests_migrated_total``, ``fleet_lease_age_seconds{host=...}``,
+``handoff_crc_rejected_total``.
 """
 
 import argparse
@@ -44,16 +50,19 @@ from typing import Dict, Optional
 
 from ..data.tokenizer import load_tokenizer
 from ..ft.lease import FileKVStore, LeaseRegistry
+from ..ft.retry import RetryDeadlineExceeded, retry_with_backoff
 from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
 from ..obs.registry import REGISTRY
 from ..utils.logging import (
     AUDIT_FLEET_DEAD_FMT,
     AUDIT_FLEET_MIGRATE_FMT,
+    AUDIT_HANDOFF_FMT,
     init_logger,
     logger,
 )
 from .journal import RequestJournal, RequestState, fold
+from .kv_cache import KVBlockIntegrityError, verify_block_artifact
 
 _M_HOSTS_LIVE = REGISTRY.gauge(
     "fleet_hosts_live",
@@ -64,6 +73,10 @@ _M_MIGRATED = REGISTRY.counter(
 _M_LEASE_AGE = REGISTRY.gauge(
     "fleet_lease_age_seconds",
     "Age of each fleet host's heartbeat lease at the last router sweep")
+_M_HANDOFF_REJECTED = REGISTRY.counter(
+    "handoff_crc_rejected_total",
+    "Handoff artifacts rejected by CRC/size/geometry verification "
+    "(the request falls back to committed-prefix replay)")
 
 
 class Router:
@@ -154,12 +167,62 @@ class Router:
 
     # -------------------------------------------------------------- migration
     def _item_from_state(self, st: RequestState, src: str) -> dict:
+        # A handoff artifact rides along only while it is CURRENT: the
+        # drain writes it at gen N and the paired requeue at N+1, so a
+        # later re-admission (gen >= N+2) means some survivor already
+        # consumed or outran the artifact — ship nothing, replay instead.
+        handoff = (st.handoff_artifact
+                   if st.handoff_artifact and st.handoff_gen >= st.gen - 1
+                   else "")
         return {"id": st.request_id, "prompt": list(st.prompt),
                 "max_new_tokens": st.max_new_tokens,
                 "temperature": st.temperature, "top_p": st.top_p,
                 "seed": st.seed, "committed": list(st.committed),
                 "gen": st.gen, "src": src, "trace_id": st.trace_id,
-                "enqueued": self.clock()}
+                "handoff": handoff, "enqueued": self.clock()}
+
+    def _verify_handoff(self, item: dict) -> str:
+        """CRC-verify the handoff artifact attached to a migration before
+        naming it in the migrate record. Transient read errors (the
+        drain's filesystem may lag the journal) are retried with backoff;
+        a CRC/size/torn-manifest failure is TERMINAL — the manifest was
+        fsynced before the journal record, so a bad byte is corruption,
+        not a race. Returns the artifact dir, or '' to degrade the
+        migration to committed-prefix replay."""
+        art = str(item.get("handoff", "") or "")
+        if not art:
+            return ""
+
+        def _verify_once():
+            try:
+                return verify_block_artifact(art)
+            except KVBlockIntegrityError as e:
+                if isinstance(e.__cause__, OSError):
+                    raise e.__cause__  # transient read error: retryable
+                raise
+
+        try:
+            manifest = retry_with_backoff(
+                _verify_once, deadline_seconds=1.0, retry_on=(OSError,),
+                clock=time.monotonic, sleep=time.sleep,
+                what=f"handoff artifact read {art}")
+        except (KVBlockIntegrityError, RetryDeadlineExceeded) as e:
+            _M_HANDOFF_REJECTED.inc()
+            events.emit_audit(
+                logger, AUDIT_HANDOFF_FMT.format(
+                    action="reject", id=item["id"], gen=item["gen"] + 1,
+                    blocks=0, detail=str(e)),
+                "handoff", id=item["id"], gen=item["gen"] + 1,
+                action="reject", artifact=art, detail=str(e))
+            return ""
+        events.emit_audit(
+            logger, AUDIT_HANDOFF_FMT.format(
+                action="ship", id=item["id"], gen=item["gen"] + 1,
+                blocks=len(manifest.get("blocks", [])),
+                detail=f"artifact {os.path.basename(art)} verified"),
+            "handoff", id=item["id"], gen=item["gen"] + 1, action="ship",
+            blocks=len(manifest.get("blocks", [])), artifact=art)
+        return art
 
     def _admit(self, item: dict, dst: str) -> None:
         """Journal one admission: a fresh ``assign`` at gen 0, or a
@@ -179,11 +242,12 @@ class Router:
                 reqtrace.emit(trace_id, rid, "placement", host=dst, gen=0)
         else:
             gen = item["gen"] + 1
+            handoff = self._verify_handoff(item)
             self.journal.migrate(rid, item["src"], dst, gen,
                                  item["prompt"], item["max_new_tokens"],
                                  item["temperature"], item["top_p"],
                                  item["seed"], item["committed"],
-                                 trace_id=trace_id)
+                                 trace_id=trace_id, handoff=handoff)
             self.assigned[rid] = (dst, gen)
             self.migrated_total += 1
             _M_MIGRATED.inc()

@@ -91,6 +91,9 @@ reports it unserved — the drain stays exact even for long prompts.
 
 import dataclasses
 import logging
+import os
+import shutil
+import tempfile
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
@@ -103,7 +106,16 @@ from ..obs.registry import (
     MetricRegistry,
     default_registry,
 )
-from ..utils.logging import AUDIT_KV_LEAK_FMT
+from ..utils.logging import (
+    AUDIT_HANDOFF_FMT,
+    AUDIT_KV_LEAK_FMT,
+    AUDIT_KV_TIER_FMT,
+)
+from .kv_cache import (
+    BLOCK_MANIFEST_NAME,
+    KVBlockIntegrityError,
+    artifact_bytes,
+)
 from .prefix_cache import PrefixCache
 
 logger = logging.getLogger()
@@ -295,6 +307,29 @@ class _Slot:
         self.spec_corrected = 0
 
 
+@dataclasses.dataclass
+class _SpilledRequest:
+    """A preempted request parked in the host spill tier: its PRIVATE
+    blocks live as a checksummed artifact on disk, its shared prefix-cache
+    blocks were released (the cache's own reference keeps them warm), and
+    everything needed to resume the stream bit-exactly — tokens, step
+    index, refeed window, timestamps — is preserved host-side. fold_in
+    (seed, step) is stateless in the step index, so the restored slot's
+    next decode folds exactly the key the preempted slot would have."""
+
+    request: Request
+    submitted_at: float
+    first_token_at: float
+    tokens: List[int]
+    steps: int
+    emitted: List[int]
+    shared_tokens: List[int]     # token ids covered by released shared blocks
+    private_positions: List[int]  # block-table positions of exported blocks
+    blocks_total: int            # full row size to re-allocate on restore
+    artifact_dir: str
+    bytes: int
+
+
 class Scheduler:
     """Continuous-batching loop over an :class:`~.engine.InferenceEngine`."""
 
@@ -303,7 +338,10 @@ class Scheduler:
                  registry: Optional[MetricRegistry] = None,
                  stop_check: Optional[Callable[[], bool]] = None,
                  adaptive_k=None, decode_burst: int = 1,
-                 prefill_batch: int = 1, adaptive_burst: bool = False):
+                 prefill_batch: int = 1, adaptive_burst: bool = False,
+                 enable_spill: bool = False,
+                 spill_dir: Optional[str] = None,
+                 on_spill: Optional[Callable[[str, int], None]] = None):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -326,6 +364,34 @@ class Scheduler:
             self.block_tables = np.zeros(
                 (engine.slots, engine.max_blocks_per_slot), np.int32)
             self._slot_blocks: Dict[int, List[int]] = {}
+        # Spill tier (module docstring): on pool exhaustion, preempt the
+        # coldest active request into a host-side checksummed artifact
+        # instead of making the head of the queue wait. A plain directory
+        # is the tier in both configs — ``spill_dir`` names a persistent
+        # location, ``enable_spill`` alone uses a process-private tmpdir
+        # (the "host RAM" tier: same code path, kernel page cache holds
+        # the bytes).
+        self.enable_spill = bool(enable_spill or spill_dir)
+        self._spill_dir_arg = spill_dir
+        self._spill_root: Optional[str] = None
+        self._spilled: Dict[str, _SpilledRequest] = {}
+        self._spill_order: List[str] = []      # FIFO restore order
+        self._on_spill = on_spill
+        self.spill_exports = 0                 # artifact ordinal (chaos key)
+        self.spill_restores = 0
+        self.spill_rejects = 0
+        # Handoff import-admission (fleet.py): request id -> verified
+        # artifact dir; _admit imports the shipped blocks instead of
+        # replay-prefilling, falling back to replay on any failure.
+        self._handoff_artifacts: Dict[str, str] = {}
+        self.handoff_imports = 0
+        self.handoff_rejects = 0
+        if self.enable_spill and self.kv_layout != "paged":
+            raise ValueError("the spill tier requires the paged KV layout")
+        if self.enable_spill and int(getattr(engine, "spec_k", 0) or 0):
+            raise ValueError("the spill tier does not support speculative "
+                             "decoding (the draft pool's blocks are "
+                             "derivable scratch, not committed state)")
         # Speculative mode: the draft model's pool gets its own allocator
         # and block table; admission requires BOTH footprints (below).
         self.spec_k = int(getattr(engine, "spec_k", 0) or 0)
@@ -528,6 +594,25 @@ class Scheduler:
             "prefix_evictions_total",
             "Cached prefix blocks evicted under pool pressure (LRU, "
             "refcount-0 only)")
+        self._m_blocks_spilled = r.gauge(
+            "kv_blocks_spilled",
+            "KV blocks currently parked in the host spill tier "
+            "(checksummed artifacts; restored on demand)")
+        self._m_spill_bytes = r.gauge(
+            "kv_spill_bytes",
+            "Payload bytes currently held by the host spill tier")
+        self._m_spill_restores = r.counter(
+            "kv_spill_restore_total",
+            "Spilled requests restored to device blocks (CRC-verified "
+            "import + prefix-cache re-acquire)")
+        self._m_handoff_shipped = r.counter(
+            "handoff_blocks_shipped_total",
+            "KV blocks moved through checksummed handoff artifacts "
+            "(exported at drain or imported on a survivor)")
+        self._m_handoff_rejected = r.counter(
+            "handoff_crc_rejected_total",
+            "Handoff artifacts rejected by CRC/size/geometry verification "
+            "(the request falls back to committed-prefix replay)")
         # Content-addressed prefix reuse: only engines that OPT IN get the
         # cache (InferenceEngine sets enable_prefix_cache in paged mode;
         # test doubles without the attribute keep plain allocation).
@@ -589,8 +674,16 @@ class Scheduler:
                     f"{int(first)} but the journal committed "
                     f"{int(committed[-1])} — journal/model divergence")
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request,
+               handoff_artifact: Optional[str] = None,
+               handoff_gen: int = 0) -> None:
         committed = list(getattr(request, "committed", ()) or ())
+        if handoff_artifact and committed:
+            # Block-shipment admission: _admit imports the artifact's
+            # committed blocks instead of replay-prefilling; any
+            # verification failure falls back to the replay path below.
+            self._handoff_artifacts[request.id] = (handoff_artifact,
+                                                   int(handoff_gen))
         if committed and len(committed) >= request.max_new_tokens:
             raise ValueError(
                 f"request {request.id}: {len(committed)} committed tokens "
@@ -628,10 +721,21 @@ class Scheduler:
 
     def pending(self) -> bool:
         return bool(self.active or self._pending_prefill
-                    or (self.queue and self.admission_open))
+                    or ((self.queue or self._spilled)
+                        and self.admission_open))
 
     def unserved(self) -> List[Request]:
-        return [r for r, _ in self.queue]
+        """Queued requests a drain leaves behind. Spilled requests count:
+        each is reported as a replay request carrying its generated tokens
+        as the committed prefix, so a journal requeue resumes the stream
+        bit-exactly on whoever picks it up (the artifact itself dies with
+        this process's tier)."""
+        out = [r for r, _ in self.queue]
+        for rid in self._spill_order:
+            sp = self._spilled[rid]
+            out.append(dataclasses.replace(sp.request,
+                                           committed=tuple(sp.tokens)))
+        return out
 
     # --- one decode iteration ----------------------------------------------
 
@@ -695,11 +799,35 @@ class Scheduler:
         return self.stop_check is not None and bool(self.stop_check())
 
     def _admit(self, done: List[Completion]) -> None:
+        if self._spilled:
+            # Parked requests come home FIRST: a restore needs only a free
+            # slot plus its private blocks (shared prefix re-acquired from
+            # the cache), and runs before any new admission can take them.
+            self._try_restores(done)
         taken = set(self.active)
         taken.update(p.slot for p in self._pending_prefill)
         free = [s for s in range(self.engine.slots) if s not in taken]
         while free and self.queue:
+            if self._spilled:
+                # A spilled request is still waiting for blocks: freed
+                # capacity flows to its restore before any NEW admission
+                # (strict anti-starvation — a preempted stream can never
+                # be overtaken indefinitely by fresh arrivals).
+                break
             req, submitted_at = self.queue[0]
+            art_entry = self._handoff_artifacts.get(req.id)
+            if (art_entry is not None and self.kv_layout == "paged"
+                    and not self.spec_k):
+                # Block-shipment admission: import the handed-off blocks
+                # instead of replay-prefilling the committed prefix.
+                outcome = self._admit_from_handoff(req, submitted_at, free,
+                                                   art_entry, done)
+                if outcome == "wait":
+                    break
+                if outcome == "imported":
+                    continue
+                # "fallback": artifact rejected — the replay path below
+                # re-derives the stream bit-exactly from prompt+committed
             # replay admissions prefill prompt + committed[:-1]; every
             # prefix-cache and prefill path below works on this view
             eff = self._effective_prompt(req)
@@ -734,6 +862,11 @@ class Scheduler:
                     if self.prefix_cache.evict(
                             fresh - self.allocator.free_count):
                         blocks = self.allocator.alloc(fresh)
+                if blocks is None and self.enable_spill:
+                    # Spill tier: preempt the coldest active request into
+                    # a host-side checksummed artifact instead of making
+                    # the head of the queue wait for a natural eviction.
+                    blocks = self._spill_for(fresh, free)
                 if blocks is None:
                     if hit is not None:
                         self.allocator.free(hit.blocks)
@@ -890,6 +1023,381 @@ class Scheduler:
                 self._finish(slot, "eos", done)
             elif len(st.tokens) >= req.max_new_tokens:
                 self._finish(slot, "length", done)
+
+    # --- spill tier + handoff (tiered KV-block lifecycle) -------------------
+
+    def _spill_tier_root(self) -> str:
+        if self._spill_root is None:
+            if self._spill_dir_arg:
+                os.makedirs(self._spill_dir_arg, exist_ok=True)
+                self._spill_root = self._spill_dir_arg
+            else:
+                self._spill_root = tempfile.mkdtemp(prefix="kv_spill_")
+        return self._spill_root
+
+    def _audit_tier(self, action: str, rid: str, blocks: int,
+                    nbytes: int) -> None:
+        tier = self._spill_dir_arg or "host-ram"
+        events.emit_audit(logger, AUDIT_KV_TIER_FMT.format(
+            action=action, id=rid, blocks=blocks, bytes=nbytes, tier=tier),
+            "kv_tier")
+
+    def _audit_handoff(self, action: str, rid: str, gen: int, blocks: int,
+                       detail: str) -> None:
+        events.emit_audit(logger, AUDIT_HANDOFF_FMT.format(
+            action=action, id=rid, gen=gen, blocks=blocks, detail=detail),
+            "handoff")
+
+    def _set_spill_gauges(self) -> None:
+        self._m_blocks_spilled.set(
+            sum(len(sp.private_positions) for sp in self._spilled.values()))
+        self._m_spill_bytes.set(
+            float(sum(sp.bytes for sp in self._spilled.values())))
+
+    def _pick_spill_victim(self) -> Optional[int]:
+        """The COLDEST active request: the one farthest from completion
+        (largest remaining token budget — it would hold its blocks the
+        longest), ties broken toward the most recently submitted, then
+        the highest slot. Deterministic for a fixed workload."""
+        best, best_key = None, None
+        for slot, st in self.active.items():
+            remaining = st.request.max_new_tokens - len(st.tokens)
+            if remaining <= 0:
+                continue
+            key = (remaining, st.submitted_at, slot)
+            if best_key is None or key > best_key:
+                best, best_key = slot, key
+        return best
+
+    def _spill_for(self, fresh: int, free: List[int]) -> Optional[List[int]]:
+        """Preempt victims until ``fresh`` blocks allocate (or no victim
+        remains). Freed victim slots rejoin the admission ``free`` list."""
+        blocks = None
+        while blocks is None:
+            victim = self._pick_spill_victim()
+            if victim is None or not self._spill_slot(victim):
+                return None
+            free.append(victim)
+            free.sort()
+            blocks = self.allocator.alloc(fresh)
+            if blocks is None and self.prefix_cache is not None:
+                if self.prefix_cache.evict(
+                        fresh - self.allocator.free_count):
+                    blocks = self.allocator.alloc(fresh)
+        return blocks
+
+    def _spill_slot(self, slot: int) -> bool:
+        """Export ``slot``'s PRIVATE blocks to the spill tier and release
+        the device row. Shared prefix-cache blocks are NOT spilled — their
+        bytes stay warm on the device under the cache's own reference and
+        the restore re-acquires them by content; only this slot's
+        references are dropped. Returns False if the slot holds nothing
+        spillable (row fully shared, or sharing isn't the leading prefix
+        the restore splice depends on)."""
+        st = self.active[slot]
+        rid = st.request.id
+        if rid in self._spilled:
+            raise RuntimeError(f"request {rid} is already spilled — "
+                               f"double spill")
+        row_blocks = list(self._slot_blocks[slot])
+        shared = 0
+        while (shared < len(row_blocks)
+               and self.allocator.refcount(row_blocks[shared]) > 1):
+            shared += 1
+        if any(self.allocator.refcount(b) > 1 for b in row_blocks[shared:]):
+            return False
+        private = row_blocks[shared:]
+        if not private:
+            return False
+        bs = self.engine.block_size
+        # positions 0..lengths[slot) hold the KV of prompt+tokens in
+        # order; the shared leading blocks therefore cover exactly the
+        # first shared*bs of that stream — the content-addressed key the
+        # restore re-matches against the prefix cache
+        full_stream = list(st.request.prompt) + [int(t) for t in st.tokens]
+        shared_tokens = full_stream[:shared * bs]
+        art_dir = os.path.join(self._spill_tier_root(),
+                               f"spill_{self.spill_exports:04d}_{rid}")
+        manifest = self.engine.export_slot_blocks(
+            private, art_dir, slot=slot,
+            meta={"kind": "spill", "request_id": rid,
+                  "tokens": [int(t) for t in st.tokens],
+                  "positions": list(range(shared, len(row_blocks)))})
+        nbytes = artifact_bytes(manifest)
+        ordinal = self.spill_exports
+        self.spill_exports += 1
+        if self._on_spill is not None:
+            # chaos hook (spill_corrupt): keyed by export ordinal
+            self._on_spill(art_dir, ordinal)
+        self._spilled[rid] = _SpilledRequest(
+            request=st.request, submitted_at=st.submitted_at,
+            first_token_at=st.first_token_at,
+            tokens=[int(t) for t in st.tokens], steps=st.steps,
+            emitted=list(st.emitted), shared_tokens=shared_tokens,
+            private_positions=list(range(shared, len(row_blocks))),
+            blocks_total=len(row_blocks), artifact_dir=art_dir,
+            bytes=nbytes)
+        self._spill_order.append(rid)
+        self.active.pop(slot)
+        del self._slot_blocks[slot]
+        self.allocator.free(row_blocks)
+        self.block_tables[slot] = 0
+        self._set_spill_gauges()
+        self._audit_tier("export", rid, len(private), nbytes)
+        self._trace(st.request, "spill", blocks=len(private), bytes=nbytes)
+        return True
+
+    def spill(self, slot: int) -> None:
+        """Explicit preemption (tests; the future SLO scheduler's
+        preempt-by-class hook): spill ``slot``'s active request to the
+        host tier now."""
+        if not self.enable_spill:
+            raise RuntimeError("spill tier disabled (enable_spill/"
+                               "spill_dir not set)")
+        if slot not in self.active:
+            raise KeyError(f"slot {slot} has no active request")
+        if not self._spill_slot(slot):
+            raise RuntimeError(f"slot {slot} holds no spillable private "
+                               f"blocks")
+
+    def _try_restores(self, done: List[Completion]) -> None:
+        taken = set(self.active)
+        taken.update(p.slot for p in self._pending_prefill)
+        free = [s for s in range(self.engine.slots) if s not in taken]
+        for rid in list(self._spill_order):
+            if not free:
+                return
+            outcome = self._restore_one(rid, free[0], done)
+            if outcome == "wait":
+                # FIFO across the tier: the oldest parked request gets the
+                # next blocks; younger ones don't overtake it
+                return
+            if outcome == "restored":
+                free.pop(0)
+
+    def _restore_one(self, rid: str, slot: int,
+                     done: List[Completion]) -> str:
+        """Bring one spilled request back onto the device: re-acquire its
+        shared prefix from the cache by content, allocate private blocks,
+        CRC-verify + import the artifact, and resurrect the slot state so
+        the next decode folds exactly the step the preempted stream would
+        have. Any failure — evicted prefix, rejected artifact — falls back
+        to a bit-exact replay from prompt+committed. Returns
+        'restored' | 'wait' | 'replay'."""
+        sp = self._spilled.get(rid)
+        if sp is None:
+            raise RuntimeError(f"request {rid} is not spilled — "
+                               f"double restore")
+        bs = self.engine.block_size
+        n_shared = len(sp.shared_tokens) // bs
+        hit = None
+        if n_shared:
+            if self.prefix_cache is not None:
+                h = self.prefix_cache.match(sp.shared_tokens)
+                if h.blocks and h.tokens >= len(sp.shared_tokens):
+                    hit = h
+            if hit is None:
+                # the cache evicted the shared prefix while we were
+                # parked: those device bytes are gone — replay fallback
+                self._spill_fallback(rid, "shared prefix evicted")
+                return "replay"
+            self.prefix_cache.acquire(hit)
+        n_private = len(sp.private_positions)
+        blocks = self.allocator.alloc(n_private)
+        if blocks is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict(
+                    n_private - self.allocator.free_count):
+                blocks = self.allocator.alloc(n_private)
+        if blocks is None:
+            if hit is not None:
+                self.allocator.free(hit.blocks)
+            return "wait"
+        try:
+            self.engine.import_slot_blocks(sp.artifact_dir, blocks, slot)
+        except KVBlockIntegrityError as e:
+            self.allocator.free(blocks)
+            if hit is not None:
+                self.allocator.free(hit.blocks)
+            self._spill_fallback(rid, f"restore rejected: {e}")
+            return "replay"
+        slot_blocks = (list(hit.blocks)[:n_shared] if hit is not None
+                       else []) + blocks
+        row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
+        row[:len(slot_blocks)] = slot_blocks
+        self.block_tables[slot] = row
+        self._slot_blocks[slot] = slot_blocks
+        st = _Slot(sp.request, sp.tokens[-1], sp.submitted_at,
+                   sp.first_token_at)
+        st.tokens = list(sp.tokens)
+        st.steps = sp.steps
+        st.emitted = list(sp.emitted)
+        self.active[slot] = st
+        self.max_concurrent = max(self.max_concurrent, len(self.active))
+        self._drop_spilled(rid)
+        self.spill_restores += 1
+        self._m_spill_restores.inc()
+        self._audit_tier("restore", rid, n_private, sp.bytes)
+        self._trace(sp.request, "restore", blocks=n_private,
+                    shared=n_shared)
+        return "restored"
+
+    def _spill_fallback(self, rid: str, detail: str) -> None:
+        """Restore impossible: requeue a replay request at the head —
+        prompt + committed re-derives the stream bit-exactly (the PR 11
+        migration invariant), so a lost/corrupt artifact costs prefill
+        compute, never correctness."""
+        sp = self._spilled[rid]
+        self.spill_rejects += 1
+        self._audit_tier("reject", rid, len(sp.private_positions), sp.bytes)
+        logger.warning("Spill restore of request %s fell back to "
+                       "committed-prefix replay: %s", rid, detail)
+        replay = dataclasses.replace(sp.request, committed=tuple(sp.tokens))
+        self.queue.appendleft((replay, sp.submitted_at))
+        self._drop_spilled(rid)
+        self._trace(sp.request, "spill_replay", blocks=0, detail=detail)
+
+    def _drop_spilled(self, rid: str) -> None:
+        sp = self._spilled.pop(rid)
+        self._spill_order.remove(rid)
+        shutil.rmtree(sp.artifact_dir, ignore_errors=True)
+        self._set_spill_gauges()
+
+    def discard_spilled(self) -> int:
+        """Drain epilogue: drop every parked artifact. The requests were
+        reported unserved with their committed prefixes (see
+        :meth:`unserved`) — the journal requeue is their durable form; the
+        tier dies with this process. Returns how many were discarded."""
+        n = len(self._spilled)
+        for rid in list(self._spill_order):
+            self._drop_spilled(rid)
+        if self._spill_root is not None and not self._spill_dir_arg:
+            shutil.rmtree(self._spill_root, ignore_errors=True)
+            self._spill_root = None
+        return n
+
+    def export_handoff(self, slot: int, out_dir: str, gen: int = 0) -> dict:
+        """Drain-with-handoff (fleet.py): serialize ``slot``'s committed
+        blocks — shared prefix included, the survivor's cache is a
+        different pool — into a checksummed artifact, release the device
+        row, and requeue the request with its committed prefix so it is
+        REPORTED unserved exactly like a plain drain. The journal's
+        ``handoff`` record then lets the router ship blocks instead of
+        replaying; a missing/torn/corrupt artifact degrades to the
+        existing replay migration. Returns the shipment summary."""
+        st = self.active[slot]
+        rid = st.request.id
+        bs = self.engine.block_size
+        length = int(np.asarray(self.engine.cache.lengths)[slot])
+        n = -(-length // bs)
+        row_blocks = list(self._slot_blocks[slot])
+        manifest = self.engine.export_slot_blocks(
+            row_blocks[:n], out_dir, slot=slot,
+            meta={"kind": "handoff", "request_id": rid,
+                  "prompt": [int(t) for t in st.request.prompt],
+                  "tokens": [int(t) for t in st.tokens],
+                  "positions": list(range(n))})
+        nbytes = artifact_bytes(manifest)
+        self.active.pop(slot)
+        del self._slot_blocks[slot]
+        self.allocator.free(row_blocks)
+        self.block_tables[slot] = 0
+        replay = dataclasses.replace(st.request, committed=tuple(st.tokens))
+        self.queue.appendleft((replay, st.submitted_at))
+        self._m_handoff_shipped.inc(n)
+        self._audit_handoff("export", rid, gen, n,
+                            os.path.basename(out_dir))
+        self._trace(st.request, "handoff_export", blocks=n, bytes=nbytes)
+        return {"dir": out_dir, "blocks": n, "bytes": nbytes,
+                "tokens": [int(t) for t in st.tokens], "request": replay}
+
+    def _admit_from_handoff(self, req: Request, submitted_at: float,
+                            free: List[int], art_entry,
+                            done: List[Completion]) -> str:
+        """Admission by block import: verify the handed-off artifact
+        (CRC + journal agreement) BEFORE touching the device, allocate the
+        request's full footprint, scatter the shipped blocks in, and
+        resurrect the slot at the exact decode step the departed host
+        would have run next — no replay prefill. Returns 'imported',
+        'wait' (pool shortage: head-of-line semantics unchanged), or
+        'fallback' (artifact rejected; the caller's replay path serves the
+        request bit-exactly)."""
+        art_dir, gen = art_entry
+        from .kv_cache import verify_block_artifact
+        committed = [int(t) for t in (req.committed or ())]
+        try:
+            manifest = verify_block_artifact(art_dir)
+        except KVBlockIntegrityError as e:
+            self._handoff_reject(req, gen, str(e))
+            return "fallback"
+        meta = manifest.get("meta", {})
+        n = len(manifest.get("blocks", []))
+        total = self._blocks_needed(req)
+        if (meta.get("kind") != "handoff"
+                or [int(t) for t in meta.get("tokens", [])] != committed
+                or ([int(t) for t in meta.get("prompt", [])]
+                    != [int(t) for t in req.prompt])
+                or n > total):
+            self._handoff_reject(req, gen,
+                                 "artifact disagrees with the journal")
+            return "fallback"
+        blocks = self.allocator.alloc(total)
+        if blocks is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict(total - self.allocator.free_count):
+                blocks = self.allocator.alloc(total)
+        if blocks is None and self.enable_spill:
+            blocks = self._spill_for(total, free)
+        if blocks is None:
+            return "wait"
+        slot = free[0]
+        try:
+            self.engine.import_slot_blocks(art_dir, blocks[:n], slot)
+        except KVBlockIntegrityError as e:
+            self.allocator.free(blocks)
+            self._handoff_reject(req, gen, str(e))
+            return "fallback"
+        self.queue.popleft()
+        free.pop(0)
+        self._handoff_artifacts.pop(req.id, None)
+        row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
+        row[:len(blocks)] = blocks
+        self.block_tables[slot] = row
+        self._slot_blocks[slot] = blocks
+        eff = self._effective_prompt(req)
+        if self.prefix_cache is not None:
+            # the imported row covers the full committed prompt — cache it
+            # so sibling prompts share it, exactly as a prefill would have
+            self.prefix_cache.insert(eff, blocks)
+            self.prefix_cache.note_admission(len(eff), len(eff))
+            self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
+        self._trace(req, "queue", dur=self.clock() - submitted_at,
+                    slot=slot)
+        st = self.active[slot] = _Slot(req, committed[-1], submitted_at,
+                                       self.clock())
+        self.handoff_imports += 1
+        self._m_handoff_shipped.inc(n)
+        self._audit_handoff("import", req.id, gen, n,
+                            os.path.basename(art_dir))
+        self._trace(req, "handoff_import", blocks=n,
+                    committed=len(committed))
+        self._trace(req, "first_token",
+                    ttft=st.first_token_at - st.submitted_at)
+        self.max_concurrent = max(self.max_concurrent, len(self.active))
+        if (self.eos_token_id is not None
+                and st.tokens[-1] == self.eos_token_id):
+            self._finish(slot, "eos", done)
+        elif len(st.tokens) >= req.max_new_tokens:
+            self._finish(slot, "length", done)
+        return "imported"
+
+    def _handoff_reject(self, req: Request, gen: int, detail: str) -> None:
+        self._handoff_artifacts.pop(req.id, None)
+        self.handoff_rejects += 1
+        self._m_handoff_rejected.inc()
+        self._audit_handoff("reject", req.id, gen, 0, detail)
+        logger.warning("Handoff import of request %s rejected (%s); "
+                       "falling back to committed-prefix replay", req.id,
+                       detail)
+        self._trace(req, "handoff_reject", detail=detail)
 
     def _abort_pending_prefill(self) -> None:
         """Drain landed while packed rows were mid-prompt: free every
@@ -1328,6 +1836,26 @@ class Scheduler:
                 leaks.append(AUDIT_KV_LEAK_FMT.format(
                     pool="draft", leaked=dextra,
                     used=self.draft_allocator.used_count, cached=dcached))
+        if self.enable_spill and self._spill_root is not None:
+            # cross-tier half of the guard: every parked request must have
+            # an intact artifact (manifest present), and every artifact
+            # directory in the tier must belong to a parked request —
+            # device pool + spill tier + cache-held = accounted
+            tracked = {sp.artifact_dir for sp in self._spilled.values()}
+            missing = [d for d in sorted(tracked) if not os.path.isfile(
+                os.path.join(d, BLOCK_MANIFEST_NAME))]
+            try:
+                on_disk = {os.path.join(self._spill_root, name)
+                           for name in os.listdir(self._spill_root)
+                           if os.path.isdir(
+                               os.path.join(self._spill_root, name))}
+            except OSError:
+                on_disk = set()
+            orphans = sorted(on_disk - tracked)
+            if missing or orphans:
+                leaks.append(AUDIT_KV_LEAK_FMT.format(
+                    pool="spill", leaked=len(missing) + len(orphans),
+                    used=len(self._spilled), cached=0))
         if leaks and not self._leak_audited:
             self._leak_audited = True
             for text in leaks:

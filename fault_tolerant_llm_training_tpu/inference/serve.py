@@ -173,6 +173,14 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "full reservation parity (slots * max_len worth). "
                         "Set LOWER to serve more slots at the same HBM, "
                         "admission queues on block exhaustion")
+    p.add_argument("--spill-dir", default="",
+                   help="spill tier for the paged KV pool: on block "
+                        "exhaustion the scheduler preempts the coldest "
+                        "request and parks its private blocks as a "
+                        "checksummed host artifact under this directory "
+                        "(inference/kv_cache.py), restoring them on demand "
+                        "bit-exactly; '' = spill disabled (admission waits "
+                        "on exhaustion instead)")
     p.add_argument("--paged-kernel", default="gather",
                    choices=("gather", "pallas"),
                    help="paged attention kernel (paged layout): 'gather' "
@@ -291,7 +299,11 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "chaos/schedule.py grammar) — delivers a real "
                         "drain signal mid-decode; 'step=<N>:reload_signal' "
                         "(keyed by reload ordinal) lands a SIGUSR1 in the "
-                        "middle of the Nth hot weight swap")
+                        "middle of the Nth hot weight swap; "
+                        "'step=<N>:spill_corrupt' (keyed by spill export "
+                        "ordinal) flips a payload byte in the Nth spill "
+                        "artifact — the restore must CRC-reject it and "
+                        "replay")
     p.add_argument("--follow", action="store_true",
                    help="continuous-deployment mode: stay up after the "
                         "initial prompts, tail --request-file for new "
@@ -424,7 +436,10 @@ def main(argv=None) -> None:
                           adaptive_k=adaptive,
                           decode_burst=args.decode_burst,
                           prefill_batch=args.prefill_batch,
-                          adaptive_burst=args.adaptive_burst)
+                          adaptive_burst=args.adaptive_burst,
+                          spill_dir=args.spill_dir or None,
+                          on_spill=(chaos.on_spill if chaos is not None
+                                    else None))
         prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
                    ) * args.repeat
         for i, text in enumerate(prompts):
@@ -644,6 +659,11 @@ def main(argv=None) -> None:
                 completed=len(sched.completed), queued=len(sched.queue)),
             "drain", phase="end", completed=len(sched.completed),
             queued=len(sched.queue))
+    if sched.enable_spill:
+        # spilled requests were reported unserved above (committed
+        # baseline in their requeue records); their artifacts are now
+        # dead weight on the host tier
+        sched.discard_spilled()
     events.emit_audit(logger, AUDIT_SERVE_COMPLETED, "complete")
     events.flush()
     reqtrace.flush()

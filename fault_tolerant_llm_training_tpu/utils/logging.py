@@ -136,3 +136,15 @@ AUDIT_FLEET_REQUEUE_FMT = ("[FLEET] Requeued request {id} to the journal "
 AUDIT_LATENCY_FMT = ("[LATENCY] Request {id} | trace {trace} | ttft "
                      "{ttft_ms:.0f} ms | tpot {tpot_ms:.2f} ms | "
                      "{tokens} tok | {reason}")
+
+# --- Tiered-KV audit trail (inference/scheduler.py spill tier,
+# inference/fleet.py + router.py block-shipment handoff) — every block
+# movement across tiers is audited: spill exports, verified restores,
+# CRC rejects (which fall back to the bit-exact committed-prefix
+# replay), and handoff shipments. scripts/chaos_campaign.py's tiered
+# scenario and tests/test_kv_tier.py grep these, frozen in
+# tests/test_audit_contract.py like the rest. ---
+AUDIT_KV_TIER_FMT = ("[KV TIER] Spill {action} request {id}: {blocks} "
+                     "block(s), {bytes} byte(s) (tier={tier})")
+AUDIT_HANDOFF_FMT = ("[HANDOFF] Block-shipment {action} request {id} "
+                     "(gen {gen}): {blocks} block(s), {detail}")

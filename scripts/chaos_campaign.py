@@ -82,7 +82,7 @@ from fault_tolerant_llm_training_tpu.obs import reqtrace  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
-             "loader_stall", "deploy", "fleet")
+             "loader_stall", "deploy", "fleet", "tiered")
 # Known container-level post-restore native crash codes (SIGABRT/SIGSEGV,
 # as rc or negative signal): the resumed process dies after the restore
 # audits are flushed. Survival is then judged on the audit trail.
@@ -749,6 +749,170 @@ def run_fleet_scenario(work: str, parquet: str, seed: int) -> Result:
     return res
 
 
+def run_tiered_scenario(work: str, parquet: str, seed: int) -> Result:
+    """Tiered KV-block lifecycle scenario: a ``--handoff`` drain ships
+    in-flight requests' committed blocks as checksummed artifacts, chaos
+    corrupts the FIRST one (``handoff_corrupt``), and the survivor — run
+    with a pool too small for its own two requests, so the spill tier
+    fires, with ``spill_corrupt`` poisoning its first spill artifact —
+    must finish all four streams bit-identical to an unfailed single-host
+    reference: verified artifacts import, corrupt ones CRC-reject into
+    committed-prefix replay, and the drain leak guard stays strict-clean
+    across the device pool and the spill tier."""
+    res = Result("tiered")
+    base = os.path.join(work, "tiered")
+    ckpts = os.path.join(base, "ckpts")
+    events_dir = os.path.join(ckpts, "events")
+    os.makedirs(base, exist_ok=True)
+    job = "tiered_a"
+
+    rc, out = _run(_train_argv(parquet, ckpts, seed,
+                               **{"--training-steps": "10",
+                                  "--checkpoint-frequency": "5"}), job)
+    if not res.check(rc == 0, f"tiered training checkpoint committed "
+                              f"(got rc {rc})"):
+        return res
+
+    store = os.path.join(base, "store")
+    jdir = os.path.join(base, "journal")
+    intake = os.path.join(base, "intake.jsonl")
+    reqs = [
+        {"id": "req0", "prompt": "alpha bravo charlie delta",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 11},
+        {"id": "req1", "prompt": "echo foxtrot golf hotel",
+         "max_new_tokens": 48, "temperature": 0.7, "top_p": 0.9,
+         "seed": seed + 12},
+        {"id": "req2", "prompt": "india juliett kilo lima",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 13},
+        {"id": "req3", "prompt": "mike november oscar papa",
+         "max_new_tokens": 48, "temperature": 0.8, "seed": seed + 14},
+    ]
+    with open(intake, "w") as fh:
+        for r in reqs:
+            fh.write(json.dumps(r) + "\n")
+
+    def host_argv(hid, chaos, extra=()):
+        return [sys.executable, "-m",
+                "fault_tolerant_llm_training_tpu.inference.fleet",
+                "--host-id", hid, "--store", store, "--journal-dir", jdir,
+                "--checkpoint-path", ckpts, "--checkpoint-job-id", job,
+                "--model", "tiny", "--tokenizer-name-or-path", "byte",
+                "--slots", "2", "--max-len", "256", "--no-eos",
+                "--lease-ttl", "2.0", "--max-run-seconds", "240",
+                "--seed", str(seed), "--chaos", chaos,
+                "--event-log",
+                os.path.join(base, f"events_{hid}.jsonl")] + list(extra)
+
+    # h0: unconstrained pool, --handoff, a SIGUSR1 drain at decode
+    # iteration 10 and a byte flip in its FIRST handoff artifact.
+    # h1 (the survivor): 8 usable blocks against two requests needing 5
+    # each — the second admission MUST spill the first — plus a byte flip
+    # in its first spill artifact, so one restore CRC-rejects into replay.
+    h0 = _ServeDriver(host_argv(
+        "h0", "step=10:sigusr1;step=0:handoff_corrupt", ["--handoff"]),
+        "tiered_h0")
+    h1 = _ServeDriver(host_argv(
+        "h1", "step=0:spill_corrupt",
+        ["--kv-num-blocks", "9",
+         "--spill-dir", os.path.join(base, "spill_h1")]), "tiered_h1")
+    router = None
+    try:
+        res.check(h0.wait_for(r"\[FLEET\] Host h0 joined", timeout=420)
+                  is not None, "host h0 joined the fleet with a lease")
+        res.check(h1.wait_for(r"\[FLEET\] Host h1 joined", timeout=420)
+                  is not None, "host h1 joined the fleet with a lease")
+        router = _ServeDriver(
+            [sys.executable, "-m",
+             "fault_tolerant_llm_training_tpu.inference.router",
+             "--store", store, "--journal-dir", jdir, "--intake", intake,
+             "--expected", "4", "--max-seconds", "180",
+             "--poll-seconds", "0.1",
+             "--event-log", os.path.join(base, "events_router.jsonl")],
+            "tiered_router")
+        rrc = router.finish(timeout=200)
+        res.check(rrc == 0, f"router completed and exited 0 (got {rrc})")
+        rc0 = h0.finish(timeout=60)
+        h1.proc.send_signal(_signal.SIGUSR1)
+        rc1 = h1.finish(timeout=120)
+    finally:
+        for drv in (h0, h1, router):
+            if drv is not None and drv.proc.poll() is None:
+                drv.proc.kill()
+                drv.finish(timeout=10)
+    rout = router.output()
+    out0, out1 = h0.output(), h1.output()
+
+    # --- handoff half: exports on h0, verify-or-replay at the router
+    exports = re.findall(r"\[HANDOFF\] Block-shipment export request "
+                         r"(req\d+)", out0)
+    res.check(rc0 == 0 and len(exports) == 2,
+              f"h0 drained via --handoff and exported both in-flight "
+              f"requests' blocks (rc {rc0}, exports {exports})")
+    res.check("[CHAOS] Injected handoff_corrupt" in out0,
+              "chaos flipped a payload byte in h0's first handoff "
+              "artifact (manifest spared)")
+    rejects = re.findall(r"\[HANDOFF\] Block-shipment reject request "
+                         r"(req\d+)", rout)
+    ships = re.findall(r"\[HANDOFF\] Block-shipment ship request "
+                       r"(req\d+)", rout)
+    res.check(len(rejects) == 1 and len(ships) == 1
+              and set(rejects) | set(ships) == set(exports),
+              f"router CRC-rejected exactly the corrupt artifact and "
+              f"shipped the other (rejects {rejects}, ships {ships})")
+    imports = re.findall(r"\[HANDOFF\] Block-shipment import request "
+                         r"(req\d+)", out1)
+    res.check(imports == ships,
+              f"survivor imported the verified artifact's blocks instead "
+              f"of replaying (imports {imports})")
+    res.check(re.search(r"Fleet router complete: 4 request\(s\) done, "
+                        r"\d+ migrated, 0 lost", rout) is not None,
+              "zero requests lost: all 4 served")
+
+    # --- spill half: h1's pool forces a preemption, chaos poisons it
+    res.check("[KV TIER] Spill export" in out1
+              and "[CHAOS] Injected spill_corrupt" in out1,
+              "survivor's constrained pool spilled a request to the host "
+              "tier and chaos corrupted the artifact")
+    res.check("[KV TIER] Spill reject" in out1,
+              "poisoned spill artifact CRC-rejected at restore and fell "
+              "back to committed-prefix replay")
+    res.check(rc1 == 0 and "Fleet drain leak guard: clean" in out1,
+              f"survivor drained leak-clean across device pool + spill "
+              f"tier and exited 0 (got rc {rc1})")
+
+    # --- bit-exactness: every stream (handoff-imported, CRC-reject
+    # replayed, spill-restored) vs ONE unfailed single-host serve
+    ref_reqs = os.path.join(base, "ref_requests.jsonl")
+    shutil.copy(intake, ref_reqs)
+    ref = _ServeDriver(_serve_argv(ckpts, job, [
+        "--seed", str(seed), "--follow", "--poll-seconds", "0.2",
+        "--request-file", ref_reqs]), "tiered_ref")
+    try:
+        for r in reqs:
+            res.check(ref.wait_for(rf"Request {r['id']} output: ",
+                                   timeout=420) is not None,
+                      f"reference serve completed {r['id']}")
+        ref.proc.send_signal(_signal.SIGUSR1)
+        ref_rc = ref.finish()
+    finally:
+        if ref.proc.poll() is None:
+            ref.proc.kill()
+            ref.finish(timeout=10)
+    res.check(ref_rc == 0, f"reference serve exited 0 (got {ref_rc})")
+    tier_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                   out0 + "\n" + out1))
+    ref_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                  ref.output()))
+    res.check(
+        len(tier_outputs) == 4 and all(
+            tier_outputs.get(f"req{i}") == ref_outputs.get(f"req{i}")
+            for i in range(4)),
+        "all streams (imported, replayed, spill-restored) bit-identical "
+        "to the unfailed reference serve")
+    _stitch_scenario(res, events_dir)
+    return res
+
+
 def format_report(results, seed: int, wall: float, extra_notes) -> str:
     lines = []
     lines.append("Chaos survival campaign")
@@ -826,6 +990,8 @@ def main(argv=None) -> int:
             res = run_deploy_scenario(work, parquet, args.seed)
         elif name == "fleet":
             res = run_fleet_scenario(work, parquet, args.seed)
+        elif name == "tiered":
+            res = run_tiered_scenario(work, parquet, args.seed)
         else:
             res = run_scenario(name, work, parquet, args.seed,
                                baseline_losses, sbatch=args.sbatch)

@@ -15,7 +15,12 @@
 #    key checks pinned line-for-line; the fleet scenario (two heartbeat-
 #    leased hosts, one SIGKILLed mid-decode, the router fences it and
 #    migrates its journaled requests onto the survivor with bit-exact
-#    replayed continuations) is pinned the same way;
+#    replayed continuations) is pinned the same way, as is the tiered
+#    scenario (a --handoff drain ships checksummed KV-block artifacts,
+#    chaos corrupts one handoff and one spill artifact, the router and
+#    the survivor CRC-reject exactly the poisoned ones and fall back to
+#    committed-prefix replay, all streams bit-match an unfailed
+#    reference);
 # 3. shared_prefix decode bench — re-runs the prefix-caching scenario
 #    and holds it to the committed BENCH_decode_prefix_cpu.json
 #    acceptance bars: cached N=8 prefill <= 2x N=1 and
@@ -47,7 +52,13 @@
 #    point completes all 24, per-point generated-token counts equal the
 #    receipt exactly (tick-based arrivals make the load deterministic),
 #    and p99 TTFT/TPOT stay under loose absolute ceilings (latency
-#    magnitudes are machine-dependent and not pinned).
+#    magnitudes are machine-dependent and not pinned);
+# 8. spill_preempt bench — re-runs the spill-vs-head-of-line-wait
+#    scenario and pins the BENCH_kv_spill_cpu.json bars: spill-on beats
+#    spill-off on the late short request's TTFT (> 1x; the magnitude is
+#    machine-dependent), at least one export+restore round-trip actually
+#    happened with zero CRC rejects, and both modes' streams bit-match
+#    the unconstrained reference.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -63,7 +74,7 @@ echo "== slow-marked suite"
 python -m pytest tests/ -q -m slow --continue-on-collection-errors \
     -p no:cacheprovider -p no:randomly
 
-echo "== chaos survival campaign (5 fault classes + deploy drill)"
+echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered drills)"
 export FAKE_SLURM_DIR="$WORK/slurm"
 cat > "$WORK/requeue.sh" <<EOF
 #!/bin/bash
@@ -123,6 +134,30 @@ do
     fi
 done
 echo "ok: fleet drill (lease -> dead verdict -> fence -> migrate) checks present"
+
+# the tiered drill's substance: the --handoff drain exported checksummed
+# block artifacts, chaos poisoned one handoff and one spill artifact,
+# the router and the survivor CRC-rejected exactly the poisoned ones
+# (falling back to committed-prefix replay), the good artifact's blocks
+# were imported instead of replayed, the survivor's constrained pool
+# spilled to the host tier and drained leak-clean across both tiers,
+# and every stream bit-matched an unfailed reference serve
+for want in \
+    "ok: h0 drained via --handoff and exported both in-flight requests' blocks" \
+    "ok: chaos flipped a payload byte in h0's first handoff artifact (manifest spared)" \
+    "ok: router CRC-rejected exactly the corrupt artifact and shipped the other" \
+    "ok: survivor imported the verified artifact's blocks instead of replaying" \
+    "ok: survivor's constrained pool spilled a request to the host tier and chaos corrupted the artifact" \
+    "ok: poisoned spill artifact CRC-rejected at restore and fell back to committed-prefix replay" \
+    "ok: survivor drained leak-clean across device pool + spill tier and exited 0 (got rc 0)" \
+    "ok: all streams (imported, replayed, spill-restored) bit-identical to the unfailed reference serve"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: tiered drill check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: tiered drill (handoff export -> CRC gate -> import-or-replay, spill -> reject -> replay) checks present"
 
 echo "== shared_prefix bench vs committed receipt"
 python scripts/decode_bench.py --scenario shared_prefix \
@@ -265,4 +300,33 @@ print(f"ok: serving load 4/4 points completed 24/24 (0 dropped), token "
       f"{TTFT_CEIL_MS:.0f} ms), p99 TPOT under {TPOT_CEIL_MS:.0f} ms")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, prefix bench, fused decode, packed prefill, tree spec, serving latency)"
+echo "== spill_preempt bench vs committed receipt"
+python scripts/decode_bench.py --scenario spill_preempt \
+    --out "$WORK/bench_spill.json"
+python - "$WORK/bench_spill.json" BENCH_kv_spill_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+assert got["bit_exact_vs_unconstrained"], (
+    "constrained streams (spill off or on) diverged from the "
+    "unconstrained reference")
+assert got["value"] > 1.0, (
+    f"spill-on no longer beats head-of-line wait on late-request TTFT "
+    f"({got['value']}x)")
+on = got["spill_on"]
+assert on["spill_exports"] >= 1 and on["spill_restores"] >= 1, (
+    f"spill-on point never round-tripped a block artifact "
+    f"(exports {on['spill_exports']}, restores {on['spill_restores']})")
+assert on["spill_rejects"] == 0, (
+    f"{on['spill_rejects']} spill artifact(s) CRC-rejected without chaos")
+assert got["spill_off"]["spill_exports"] == 0, (
+    "spill-off baseline exported blocks — the A/B is contaminated")
+assert want["bit_exact_vs_unconstrained"], "committed receipt is stale"
+print(f"ok: spill-on {got['value']}x spill-off on late-request TTFT "
+      f"(> 1x), {on['spill_exports']} export(s)/{on['spill_restores']} "
+      f"restore(s), 0 rejects, streams bit-exact vs unconstrained")
+EOF
+
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill)"

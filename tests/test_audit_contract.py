@@ -116,6 +116,12 @@ FROZEN = {
     "AUDIT_LATENCY_FMT":
         "[LATENCY] Request {id} | trace {trace} | ttft {ttft_ms:.0f} ms "
         "| tpot {tpot_ms:.2f} ms | {tokens} tok | {reason}",
+    "AUDIT_KV_TIER_FMT":
+        "[KV TIER] Spill {action} request {id}: {blocks} block(s), "
+        "{bytes} byte(s) (tier={tier})",
+    "AUDIT_HANDOFF_FMT":
+        "[HANDOFF] Block-shipment {action} request {id} (gen {gen}): "
+        "{blocks} block(s), {detail}",
 }
 
 
