@@ -13,6 +13,17 @@ The pointer optionally carries a ``draft`` sub-pointer (same fields) so a
 speculative-decoding deployment can refresh target and draft weights in
 the same serving-side swap.
 
+QUANTIZE-AT-PUBLISH (``--weights-dtype int8``): the trainer side — not
+the serving side — pays for quantization. The step's params are restored
+once, quantized per-tensor (symmetric int8, one fp32 scale each, the same
+``(int8 * scale)`` dequant rule as the paged KV pools), and written as a
+weights ARTIFACT next to the checkpoint tree with its own integrity
+manifest (the identical per-file size+CRC sweep a checkpoint gets). The
+pointer then carries an additive ``weights`` sub-entry naming the
+artifact, so old pointers still parse and a serving watcher that predates
+the field just ignores it. A corrupt artifact is rejected by
+verify-before-load exactly like any corrupt publish.
+
 ``python -m fault_tolerant_llm_training_tpu.deploy.publish`` republishes
 any manifested step by hand — the campaign driver uses it to stage
 rollbacks and chaos-corrupted publishes.
@@ -23,10 +34,18 @@ import dataclasses
 import hashlib
 import json
 import os
+import shutil
 import sys
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from ..checkpoint.manager import MANIFEST_NAME, _fsync_dir, verify_step_dir
+import numpy as np
+
+from ..checkpoint.manager import (
+    MANIFEST_NAME,
+    _fsync_dir,
+    verify_step_dir,
+    write_manifest,
+)
 from ..obs import events
 from ..obs.registry import REGISTRY
 from ..utils.logging import AUDIT_PUBLISH_FMT, init_logger, logger
@@ -47,13 +66,17 @@ class Pointer:
     verify it. ``path`` is the step directory relative to the checkpoint
     root (the directory holding ``published.json``); ``draft`` is an
     optional dict with the same ``step``/``job_id``/``path``/
-    ``manifest_digest`` keys for the speculative draft model."""
+    ``manifest_digest`` keys for the speculative draft model; ``weights``
+    is an optional dict (same keys plus ``dtype``/``nbytes``) naming a
+    quantized weights artifact built at publish time — additive, so
+    pointers without it keep the classic restore-from-checkpoint path."""
 
     step: int
     job_id: str
     path: str
     manifest_digest: str
     draft: Optional[dict] = None
+    weights: Optional[dict] = None
     version: int = 1
 
 
@@ -99,6 +122,7 @@ def read_pointer_strict(root: str) -> Optional[Pointer]:
                    path=str(data["path"]),
                    manifest_digest=str(data["manifest_digest"]),
                    draft=data.get("draft"),
+                   weights=data.get("weights"),
                    version=int(data.get("version", 1)))
 
 
@@ -130,8 +154,8 @@ def _verify_target(root: str, path: str, digest: str) -> Tuple[bool, str]:
 def verify_pointer(root: str, ptr: Pointer) -> Tuple[bool, str]:
     """Verify-before-load: the published step's manifest must be the one
     that was published (sha256) AND every manifest-listed file must pass
-    its size/CRC check — for the draft sub-pointer too, when present.
-    Returns ``(ok, detail)``."""
+    its size/CRC check — for the draft and weights sub-pointers too, when
+    present. Returns ``(ok, detail)``."""
     ok, detail = _verify_target(root, ptr.path, ptr.manifest_digest)
     if not ok:
         return ok, detail
@@ -143,6 +167,14 @@ def verify_pointer(root: str, ptr: Pointer) -> Tuple[bool, str]:
             return False, "malformed draft sub-pointer"
         if not ok:
             return False, f"draft {detail}"
+    if ptr.weights is not None:
+        try:
+            ok, detail = _verify_target(root, str(ptr.weights["path"]),
+                                        str(ptr.weights["manifest_digest"]))
+        except (KeyError, TypeError):
+            return False, "malformed weights sub-pointer"
+        if not ok:
+            return False, f"weights {detail}"
     return True, "ok"
 
 
@@ -158,6 +190,136 @@ def newest_manifested_step(root: str, job_id: str) -> Optional[int]:
         if manifest_digest(os.path.join(d, str(step))) is not None:
             return step
     return None
+
+
+# --- Quantized weights artifact -------------------------------------------
+#
+# Layout (one directory per published step, sibling of checkpoint_{job}):
+#
+#   weights_int8_{job_id}/{step}/
+#     t0000.npy ... tNNNN.npy   int8 payload, one file per param tensor
+#     weights.json              tensor table: name (path into the params
+#                               tree), file, shape, original dtype, fp32
+#                               scale — everything reload needs to rebuild
+#                               the tree bit-for-bit in artifact precision
+#     integrity.json            the SAME per-file size+CRC manifest a
+#                               checkpoint step gets (write_manifest)
+#
+# Per-tensor symmetric quantization: scale = amax/127, q = clip(round(
+# x/scale)). Dequant mirrors the KV-pool rule: (int8 * scale) -> dtype.
+
+WEIGHTS_META_NAME = "weights.json"
+WEIGHTS_QMAX = 127.0
+
+
+def _flatten_params(tree, prefix=()) -> List[Tuple[str, object]]:
+    """Deterministic (path, leaf) list for a nested params dict; paths are
+    '/'-joined key chains, sorted so the artifact's tensor order is stable
+    across publishes of the same tree."""
+    if isinstance(tree, dict) or hasattr(tree, "items"):
+        out: List[Tuple[str, object]] = []
+        for k in sorted(tree):
+            out.extend(_flatten_params(tree[k], prefix + (str(k),)))
+        return out
+    return [("/".join(prefix), tree)]
+
+
+def _unflatten_params(items) -> dict:
+    tree: dict = {}
+    for name, leaf in items:
+        node = tree
+        *parents, last = name.split("/")
+        for k in parents:
+            node = node.setdefault(k, {})
+        node[last] = leaf
+    return tree
+
+
+def quantize_tensor(arr) -> Tuple[np.ndarray, float]:
+    """Symmetric per-tensor int8: returns ``(q, scale)`` with
+    ``q = clip(round(arr / scale), -127, 127)`` and
+    ``scale = amax / 127`` (1.0 for an all-zero tensor, so dequant is
+    exact there too)."""
+    a = np.asarray(arr, dtype=np.float32)
+    amax = float(np.max(np.abs(a))) if a.size else 0.0
+    scale = amax / WEIGHTS_QMAX if amax > 0.0 else 1.0
+    q = np.clip(np.rint(a / scale), -WEIGHTS_QMAX, WEIGHTS_QMAX)
+    return q.astype(np.int8), scale
+
+
+def write_weights_artifact(root: str, job_id: str, step: int, params,
+                           dtype: str = "int8") -> dict:
+    """Quantize ``params`` and commit the artifact directory; returns the
+    pointer's ``weights`` sub-entry. The build happens in a ``.tmp``
+    sibling that is renamed into place only after the integrity manifest
+    is written — a crash mid-build leaves no half-artifact a reader could
+    mistake for a publishable one."""
+    if dtype != "int8":
+        raise ValueError(f"unsupported weights artifact dtype {dtype!r}")
+    root = os.path.abspath(root)
+    final = os.path.join(root, f"weights_{dtype}_{job_id}", str(int(step)))
+    tmp = final + ".tmp"
+    for d in (final, tmp):
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    os.makedirs(tmp)
+    tensors: List[Dict[str, object]] = []
+    nbytes = 0
+    for i, (name, leaf) in enumerate(_flatten_params(params)):
+        q, scale = quantize_tensor(leaf)
+        fname = f"t{i:04d}.npy"
+        np.save(os.path.join(tmp, fname), q)
+        tensors.append({"name": name, "file": fname,
+                        "shape": list(q.shape),
+                        "dtype": str(jnp_dtype_name(leaf)),
+                        "scale": scale})
+        nbytes += q.nbytes
+    meta = {"version": 1, "dtype": dtype, "step": int(step),
+            "job_id": str(job_id), "nbytes": int(nbytes),
+            "tensors": tensors}
+    with open(os.path.join(tmp, WEIGHTS_META_NAME), "w") as fh:
+        json.dump(meta, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    write_manifest(tmp, int(step))
+    os.rename(tmp, final)
+    _fsync_dir(os.path.dirname(final))
+    return {"step": int(step), "job_id": str(job_id),
+            "path": os.path.relpath(final, root),
+            "manifest_digest": manifest_digest(final),
+            "dtype": dtype, "nbytes": int(nbytes)}
+
+
+def jnp_dtype_name(leaf) -> str:
+    """Original dtype of a params leaf as a string ``jnp.dtype`` round-
+    trips (``bfloat16`` included, via ml_dtypes)."""
+    return str(getattr(leaf, "dtype", np.dtype(np.float32)))
+
+
+def load_weights_artifact(root: str, weights: dict):
+    """Rebuild the params tree from a VERIFIED artifact (the caller runs
+    :func:`verify_pointer` first; this function trusts the bytes).
+    Dequantizes each tensor with the shared ``(int8 * scale) -> dtype``
+    rule back to its original checkpoint dtype, so the tree drops into
+    ``engine.reload_params`` exactly like a checkpoint restore would."""
+    import jax.numpy as jnp
+
+    art_dir = os.path.join(os.path.abspath(root), str(weights["path"]))
+    with open(os.path.join(art_dir, WEIGHTS_META_NAME)) as fh:
+        meta = json.load(fh)
+    if meta.get("dtype") != "int8":
+        raise ValueError(
+            f"unsupported weights artifact dtype {meta.get('dtype')!r}")
+    items = []
+    for t in meta["tensors"]:
+        q = np.load(os.path.join(art_dir, t["file"]))
+        if list(q.shape) != list(t["shape"]) or q.dtype != np.int8:
+            raise ValueError(
+                f"weights artifact tensor {t['name']} geometry mismatch")
+        deq = q.astype(np.float32) * np.float32(t["scale"])
+        items.append((str(t["name"]),
+                      jnp.asarray(deq, dtype=jnp.dtype(str(t["dtype"])))))
+    return _unflatten_params(items)
 
 
 class Publisher:
@@ -179,12 +341,13 @@ class Publisher:
         return os.path.join(self.root, f"checkpoint_{job_id or self.job_id}",
                             str(step))
 
-    def publish(self, step: int,
-                draft: Optional[dict] = None) -> Optional[Pointer]:
+    def publish(self, step: int, draft: Optional[dict] = None,
+                weights: Optional[dict] = None) -> Optional[Pointer]:
         """Publish ``step`` (which must carry an integrity manifest);
         returns the committed pointer, or None if the step is not
         publishable. ``draft`` is an optional pre-built draft sub-pointer
-        dict (see :func:`draft_pointer`)."""
+        dict (see :func:`draft_pointer`); ``weights`` an optional
+        pre-built weights sub-entry (see :meth:`quantize_weights`)."""
         step_dir = self.step_dir(step)
         digest = manifest_digest(step_dir)
         if digest is None:
@@ -194,7 +357,7 @@ class Publisher:
             return None
         ptr = Pointer(step=int(step), job_id=self.job_id,
                       path=os.path.relpath(step_dir, self.root),
-                      manifest_digest=digest, draft=draft)
+                      manifest_digest=digest, draft=draft, weights=weights)
         write_pointer(self.root, ptr)
         _M_PUBLISHED.inc()
         _M_PUBLISHED_STEP.set(int(step))
@@ -202,7 +365,7 @@ class Publisher:
             logger,
             AUDIT_PUBLISH_FMT.format(step=int(step), digest=digest[:12]),
             "publish", step=int(step), digest=digest, path=ptr.path,
-            draft=bool(draft))
+            draft=bool(draft), weights=bool(weights))
         events.flush()
         if self.chaos is not None:
             # post-commit corruption window: the pointer is live, the
@@ -227,6 +390,25 @@ class Publisher:
                 "path": os.path.relpath(step_dir, self.root),
                 "manifest_digest": digest}
 
+    def quantize_weights(self, step: int, cfg,
+                         dtype: str = "int8") -> Optional[dict]:
+        """Restore ``step``'s params (the same cross-topology path serving
+        uses) and stage a quantized weights artifact for it; returns the
+        pointer's ``weights`` sub-entry, or None if the restore landed on
+        a different step (the artifact must be the step the pointer
+        names, never a silent fallback)."""
+        from ..inference.engine import restore_params
+
+        params, got = restore_params(self.root, self.job_id, cfg,
+                                     step=int(step))
+        if got != int(step):
+            logger.warning(
+                f"[DEPLOY] weights restore fell back to step {got}; not "
+                f"staging a quantized artifact for step {step}")
+            return None
+        return write_weights_artifact(self.root, self.job_id, int(step),
+                                      params, dtype=dtype)
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
@@ -244,6 +426,24 @@ def main(argv=None) -> int:
                         "checkpoints (same checkpoint root)")
     p.add_argument("--draft-step", type=int, default=None,
                    help="draft step (default: newest manifested)")
+    p.add_argument("--weights-dtype", default="bf16",
+                   choices=("bf16", "int8"),
+                   help="bf16 (default): pointer only, serving restores "
+                        "the checkpoint itself. int8: also stage a "
+                        "per-tensor-quantized weights artifact (own CRC "
+                        "manifest) and point serving at it — the reload "
+                        "swap then never touches the full-precision "
+                        "checkpoint")
+    p.add_argument("--model", default="tiny",
+                   help="model preset of the published checkpoint (only "
+                        "used by --weights-dtype int8 to rebuild the "
+                        "abstract tree for the one-time restore)")
+    p.add_argument("--vocab-size", type=int, default=0,
+                   help="vocab size the checkpoint was trained with "
+                        "(required with --weights-dtype int8)")
+    p.add_argument("--layer-impl", default="loop",
+                   help="layer_impl the checkpoint was trained with "
+                        "(only used by --weights-dtype int8)")
     p.add_argument("--chaos", default="",
                    help="fault schedule keyed by the published step "
                         "(publish_corrupt only)")
@@ -276,7 +476,23 @@ def main(argv=None) -> int:
             logger.error("[DEPLOY] no manifested draft checkpoint step "
                          "to publish")
             return 2
-    ptr = pub.publish(step, draft=draft)
+    weights = None
+    if args.weights_dtype != "bf16":
+        if args.vocab_size <= 0:
+            logger.error("[DEPLOY] --weights-dtype int8 needs "
+                         "--vocab-size to rebuild the restore tree")
+            return 2
+        from ..models.configs import get_config
+
+        cfg = get_config(args.model, vocab_size=args.vocab_size,
+                         layer_impl=args.layer_impl)
+        weights = pub.quantize_weights(step, cfg,
+                                       dtype=args.weights_dtype)
+        if weights is None:
+            logger.error("[DEPLOY] could not stage the quantized weights "
+                         "artifact; not publishing")
+            return 2
+    ptr = pub.publish(step, draft=draft, weights=weights)
     events.flush()
     return 0 if ptr is not None else 2
 

@@ -17,7 +17,11 @@
                restore that silently fell back to an OLDER step
                (checkpoint/manager.py _verified_step) is treated as a
                rejection — the pointer names ONE step, serving never
-               downgrades implicitly
+               downgrades implicitly. When the pointer carries a
+               ``weights`` sub-entry (quantize-at-publish,
+               deploy/publish.py), the CRC-verified int8 artifact is
+               loaded and dequantized instead — serving never reads the
+               full-precision checkpoint at all
     SWAP    -> engine.reload_params installs the new arrays into the
                running AOT programs (no re-compile — the programs take
                params per call, only the cache is donated); draft params
@@ -47,11 +51,20 @@ from ..utils.logging import (
     AUDIT_RELOAD_REJECTED_FMT,
     logger,
 )
-from .publish import Pointer, read_pointer_strict, verify_pointer
+from .publish import (
+    Pointer,
+    load_weights_artifact,
+    read_pointer_strict,
+    verify_pointer,
+)
 
 _M_RELOADS = REGISTRY.counter(
     "ftl_weights_reload_total",
     "Hot weight swaps completed by the serving process")
+_M_WEIGHTS_BYTES = REGISTRY.gauge(
+    "weights_artifact_bytes",
+    "Payload bytes of the quantized weights artifact currently serving "
+    "(0 when weights came from a full-precision checkpoint restore)")
 _M_REJECTED = REGISTRY.counter(
     "ftl_weights_reload_rejected_total",
     "Published checkpoints rejected by verify-before-load")
@@ -156,14 +169,28 @@ class HotReloader:
         t0 = self.clock()
         was_open = self.scheduler.admission_open
         self.scheduler.stop_admission()
+        art_bytes = 0
         try:
-            params, got = restore_params(
-                self.root, ptr.job_id, self.cfg, step=ptr.step,
-                mesh=getattr(self.engine, "mesh", None))
-            if got != ptr.step:
-                self._reject(ptr, f"restore fell back to step {got}",
-                             current)
-                return False
+            if ptr.weights is not None:
+                # quantize-at-publish path: the verified artifact IS the
+                # weights — dequantized back to checkpoint dtype, the
+                # full-precision checkpoint is never read by serving
+                if int(ptr.weights.get("step", -1)) != ptr.step:
+                    self._reject(
+                        ptr, "weights sub-pointer names step "
+                             f"{ptr.weights.get('step')}, pointer names "
+                             f"{ptr.step}", current)
+                    return False
+                params = load_weights_artifact(self.root, ptr.weights)
+                art_bytes = int(ptr.weights.get("nbytes", 0))
+            else:
+                params, got = restore_params(
+                    self.root, ptr.job_id, self.cfg, step=ptr.step,
+                    mesh=getattr(self.engine, "mesh", None))
+                if got != ptr.step:
+                    self._reject(ptr, f"restore fell back to step {got}",
+                                 current)
+                    return False
             if self.cfg.layer_impl == "scan":
                 # the engine converted to loop form at build; mirror it
                 params = unstack_layer_params(params, self.cfg.n_layers)
@@ -211,12 +238,14 @@ class HotReloader:
         _M_RELOADS.inc()
         _M_STEP.set(int(ptr.step))
         _M_SWAP.observe(dt)
+        _M_WEIGHTS_BYTES.set(art_bytes)
         events.emit_audit(
             logger,
             AUDIT_RELOAD_FMT.format(old=current, new=ptr.step,
                                     active=len(self.scheduler.active),
                                     ms=dt * 1e3),
             "weights_reload", step=int(ptr.step), old=current, dur=dt,
-            active=len(self.scheduler.active), draft=bool(ptr.draft))
+            active=len(self.scheduler.active), draft=bool(ptr.draft),
+            weights=bool(ptr.weights), artifact_bytes=art_bytes)
         events.flush()
         return True

@@ -280,9 +280,35 @@ class InferenceEngine:
                  spec_tree=None,
                  prefix_cache: bool = True,
                  paged_kernel: str = "gather",
-                 prefill_batch: int = 1):
+                 prefill_batch: int = 1,
+                 kv_dtype: str = "bf16"):
         if kv_layout not in ("paged", "ring"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}: 'bf16' "
+                             f"(plain pools) or 'int8' (quantized pools "
+                             f"with per-(block, kv-head) fp32 scales — "
+                             f"kv_cache.QuantPool)")
+        if kv_dtype == "int8":
+            if kv_layout != "paged":
+                raise ValueError("kv_dtype='int8' requires the paged KV "
+                                 "layout: the scale pool is per-block, and "
+                                 "the ring path has no block granularity "
+                                 "to hang scales on")
+            if cache_dtype is not None and (jnp.dtype(cache_dtype)
+                                            != jnp.dtype(jnp.int8)):
+                raise ValueError(
+                    f"kv_dtype='int8' conflicts with cache_dtype="
+                    f"{jnp.dtype(cache_dtype).name!r}: pass one or the "
+                    f"other")
+            cache_dtype = jnp.int8
+        elif cache_dtype is not None and (jnp.dtype(cache_dtype)
+                                          == jnp.dtype(jnp.int8)):
+            kv_dtype = "int8"  # dtype request IS the mode switch
+            if kv_layout != "paged":
+                raise ValueError("int8 cache_dtype requires the paged KV "
+                                 "layout")
+        self.kv_dtype = kv_dtype
         if paged_kernel not in ("gather", "pallas"):
             raise ValueError(
                 f"unknown paged_kernel {paged_kernel!r}: 'gather' "

@@ -59,6 +59,7 @@ from ..obs.prometheus import MetricsServer
 from ..utils.logging import (
     AUDIT_FLEET_JOIN_FMT,
     AUDIT_FLEET_LEAVE_FMT,
+    AUDIT_KV_QUANT_FMT,
     AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
     AUDIT_SERVE_DRAINING_FMT,
@@ -72,6 +73,7 @@ from .engine import (
     enable_compilation_cache,
 )
 from .journal import RequestJournal, persist_unserved
+from .kv_cache import bf16_block_bytes, block_bytes
 from .scheduler import Request, Scheduler
 
 ROUTER_JOURNAL = "router.jsonl"
@@ -157,6 +159,18 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-len", type=int, default=0)
     p.add_argument("--kv-block-size", type=int, default=16)
     p.add_argument("--kv-num-blocks", type=int, default=0)
+    p.add_argument("--kv-dtype", default="bf16",
+                   choices=("bf16", "int8"),
+                   help="paged KV pool storage dtype (serve.py "
+                        "--kv-dtype): int8 stores blocks quantized with "
+                        "per-(block, kv-head) scales, ~2x blocks at the "
+                        "same HBM. Handoff/spill artifacts carry the "
+                        "scales inside the CRC'd payload, so migration "
+                        "stays bit-exact within the dtype — but every "
+                        "fleet host must run the SAME kv-dtype: an "
+                        "artifact exported under one dtype is geometry-"
+                        "rejected by the other and the migration falls "
+                        "back to the committed-prefix replay")
     p.add_argument("--paged-kernel", default="gather",
                    choices=("gather", "pallas"))
     p.add_argument("--compile-cache-dir", default=None)
@@ -235,7 +249,8 @@ def main(argv=None) -> None:
             max_len=args.max_len or None, kv_layout="paged",
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks or None,
-            paged_kernel=args.paged_kernel)
+            paged_kernel=args.paged_kernel,
+            kv_dtype=args.kv_dtype)
         events.emit_audit(
             logger, AUDIT_SERVE_READY_FMT.format(
                 model=args.model, step=engine.restored_step,
@@ -441,6 +456,15 @@ def main(argv=None) -> None:
     else:
         logger.warning("Fleet drain leak guard: %d violation(s)",
                        len(leaks))
+    # the --kv-dtype receipt, same line serve.py's drain summary emits
+    bpb = block_bytes(engine.cache)
+    ratio = bf16_block_bytes(engine.cache) / bpb
+    events.emit_audit(
+        logger, AUDIT_KV_QUANT_FMT.format(
+            dtype=engine.kv_dtype, bytes_per_block=bpb, ratio=ratio,
+            blocks_total=engine.num_blocks),
+        "kv_quant", dtype=engine.kv_dtype, bytes_per_block=bpb,
+        ratio=ratio, blocks_total=engine.num_blocks)
     # Per-request latency audit: the drain summary every SLO check greps.
     for c in sched.completed:
         events.emit_audit(

@@ -44,6 +44,16 @@ Sharding under the training mesh (parallel/mesh.py): ``kv_heads`` rides the
 (parallel/sharding.py LOGICAL_RULES) in BOTH layouts (it is dim 1 of the
 ring buffer and of the block pool alike); slots/blocks/positions stay
 replicated.
+
+**Quantized paged mode** (``init_paged_cache(dtype=jnp.int8)``) stores each
+layer's pool as a :class:`QuantPool`: an int8 block pool plus a parallel
+per-(block, kv_head) fp32 scale pool, vLLM/KIVI-style symmetric per-block
+quantization. Halving bytes-per-position doubles ``kv_blocks_total`` at a
+fixed HBM budget — which the paged admission gate converts directly into
+concurrency. The scale invariant is deliberately simple (a block's scale is
+owned by the row at its local position 0; see ``_quantized_scatter``) so
+every write stays row-granular like the bf16 path and the within-dtype
+bit-exactness contracts survive unchanged.
 """
 
 import json
@@ -118,6 +128,85 @@ def blocks_per_slot(max_len: int, block_size: int) -> int:
     return -(-max_len // block_size)
 
 
+KV_QUANT_QMAX = 127.0  # symmetric int8: q in [-127, 127], -128 unused
+
+
+class QuantPool(struct.PyTreeNode):
+    """One layer's int8 paged block pool plus its parallel scale pool.
+
+    ``q`` keeps the bf16 pool's exact geometry at one byte per element;
+    ``scale`` holds one fp32 dequant scale per (block, kv_head). The
+    ``shape``/``dtype`` properties mirror a plain array pool so every
+    shape-derived consumer (block table reach in models/llama.py, engine
+    geometry, export manifests) reads a QuantPool without branching, and as
+    a ``struct.PyTreeNode`` it is transparent to jit/donation/eval_shape —
+    the int8-mode :class:`PagedKVCache` simply carries QuantPools in its
+    ``k``/``v`` tuples.
+
+    The dequant rule — ``q.astype(float32) * scale`` cast once to the
+    compute dtype — is THE shared contract: the gather reference applies it
+    after the gather (ops/attention.py ``gather_kv_blocks``) and the Pallas
+    kernels apply it to the block right after its DMA lands in VMEM
+    (ops/paged_attention.py), so the two impls differ only by the online
+    softmax's fp32 reordering, same as the bf16 parity story."""
+
+    q: jax.Array      # (num_blocks, kv_heads, block_size, head_dim) int8
+    scale: jax.Array  # (num_blocks, kv_heads) fp32
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_rows(rows: jax.Array, scale: jax.Array) -> jax.Array:
+    """Symmetric round-to-nearest: (R, K, D) fp32 rows at per-(row, head)
+    ``scale`` (R, K) into int8 [-127, 127]. Zero scales (a block whose
+    position-0 row was exactly zero) degrade to divisor 1 so the result
+    stays finite and deterministic — dequant then reproduces the zeros
+    exactly."""
+    safe = jnp.where(scale > 0, scale, 1.0)[:, :, None]
+    return jnp.clip(jnp.round(rows / safe), -KV_QUANT_QMAX,
+                    KV_QUANT_QMAX).astype(jnp.int8)
+
+
+def _quantized_scatter(pool: QuantPool, blk: jax.Array, off: jax.Array,
+                       rows: jax.Array) -> QuantPool:
+    """Land fp32 ``rows`` (R, kv_heads, head_dim) at ``(blk[r], :, off[r],
+    :)`` of an int8 pool, maintaining the scale invariant:
+
+    **A block's scale is owned by its local position 0.** A row landing at
+    block-local offset 0 SETS the block's per-head scale to its own
+    amax/127 — a plain overwrite, never a running max — and every row
+    landing at offset > 0 quantizes at the scale already in the pool,
+    clipped into [-127, 127]. Positions are committed in sequence order, so
+    a block's position 0 is always written before its higher offsets, and
+    existing content is NEVER requantized: a write stays row-granular
+    exactly like the bf16 scatter. That is the property the within-dtype
+    bit-exactness contracts (exact spec-verify, burst decode, packed
+    prefill, COW resume) lean on — a rejected speculative row can disturb a
+    scale only at an offset-0 position the committed stream's own next
+    write deterministically resets with identical inputs. Clipping rows
+    that outgrow their block's committed scale is the accuracy cost of that
+    determinism; the parity check's adversarial matrix bounds it.
+
+    Rows diverted to null block 0 (masked writes, and offset>0 rows' scale
+    lane below) may scribble scale[0]; harmless — null-block lanes are
+    additively masked to exactly zero attention weight, so scale[0] is
+    never read live."""
+    amax = jnp.max(jnp.abs(rows), axis=-1)            # (R, K)
+    setter = off == 0
+    scale_blk = jnp.where(setter, blk, 0)
+    new_scale = pool.scale.at[scale_blk, :].set(amax / KV_QUANT_QMAX)
+    row_scale = new_scale[blk]                        # post-update gather
+    return QuantPool(
+        q=pool.q.at[blk, :, off, :].set(quantize_rows(rows, row_scale)),
+        scale=new_scale)
+
+
 def init_paged_cache(cfg: TransformerConfig, slots: int, max_len: int,
                      block_size: int, num_blocks: Optional[int] = None,
                      dtype=None) -> PagedKVCache:
@@ -133,6 +222,18 @@ def init_paged_cache(cfg: TransformerConfig, slots: int, max_len: int,
                          f"reserved null block, at least one usable block "
                          f"is required")
     shape = (num_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        # Quantized mode: int8 pools + per-(block, kv_head) fp32 scales.
+        # Requesting the pool dtype IS the mode switch, so reset/rebuild
+        # paths that thread ``cache.k[0].dtype`` round-trip for free.
+        def pool():
+            return QuantPool(
+                q=jnp.zeros(shape, jnp.int8),
+                scale=jnp.zeros((num_blocks, cfg.kv_heads), jnp.float32))
+        return PagedKVCache(
+            k=tuple(pool() for _ in range(cfg.n_layers)),
+            v=tuple(pool() for _ in range(cfg.n_layers)),
+            lengths=jnp.zeros((slots,), jnp.int32))
     return PagedKVCache(
         k=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)),
         v=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)),
@@ -154,7 +255,11 @@ def write_paged_kv(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
     slot's OWN committed KV at ``pos % bs`` and silently corrupt it. Valid
     in-range positions map to distinct (block, offset) pairs (the allocator
     hands each slot disjoint blocks), so the scatter is collision-free where
-    it matters."""
+    it matters.
+
+    A :class:`QuantPool` takes the identical (block, offset) routing; the
+    rows quantize through :func:`_quantized_scatter` (offset-0 rows set
+    their block's scale, the rest quantize at it)."""
     bs = pool.shape[2]
     b, k, s, d = new.shape
     pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]   # (B, S)
@@ -165,6 +270,9 @@ def write_paged_kv(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
                     jnp.take_along_axis(block_tables, idx, axis=1), 0)
     off = pos % bs
     upd = jnp.transpose(new, (0, 2, 1, 3)).reshape(b * s, k, d)
+    if isinstance(pool, QuantPool):
+        return _quantized_scatter(pool, blk.reshape(-1), off.reshape(-1),
+                                  upd.astype(jnp.float32))
     return pool.at[blk.reshape(-1), :, off.reshape(-1), :].set(upd)
 
 
@@ -201,6 +309,16 @@ def remap_paged_path(pool: jax.Array, block_tables: jax.Array,
                         jnp.take_along_axis(
                             block_tables, jnp.clip(dst_pos // bs, 0, nb - 1),
                             axis=1), 0)
+    if isinstance(pool, QuantPool):
+        # Dequantize the gathered rows at their SOURCE blocks' scales, then
+        # requantize through the standard scatter at the destination (a
+        # move crossing into a fresh block lands at local offset 0 and sets
+        # that block's scale, same as a sequential write would have).
+        q_rows = pool.q[src_blk.reshape(-1), :, (src_pos % bs).reshape(-1), :]
+        src_scale = pool.scale[src_blk.reshape(-1)]
+        rows = q_rows.astype(jnp.float32) * src_scale[:, :, None]
+        return _quantized_scatter(pool, dst_blk.reshape(-1),
+                                  (dst_pos % bs).reshape(-1), rows)
     vals = pool[src_blk.reshape(-1), :, (src_pos % bs).reshape(-1), :]
     return pool.at[dst_blk.reshape(-1), :,
                    (dst_pos % bs).reshape(-1), :].set(vals)
@@ -214,7 +332,13 @@ def copy_kv_block(pool: jax.Array, src: jax.Array, dst: jax.Array
     full-prompt hit resuming at the last prompt position) first duplicates
     the block into a private one and remaps its table entry; the shared
     original is never written. Bitwise copy of committed bytes, so the
-    divergent stream stays bit-identical to an uncached run."""
+    divergent stream stays bit-identical to an uncached run. A
+    :class:`QuantPool` copies BOTH the int8 row and its scale row bitwise —
+    the copy dequantizes to exactly the original's values, so COW resumes
+    stay bit-identical within the quantized mode too."""
+    if isinstance(pool, QuantPool):
+        return QuantPool(q=pool.q.at[dst].set(pool.q[src]),
+                         scale=pool.scale.at[dst].set(pool.scale[src]))
     return pool.at[dst].set(pool[src])
 
 
@@ -290,6 +414,64 @@ def _cache_geometry(cache: PagedKVCache) -> Dict[str, object]:
     }
 
 
+def _np_dtype(arr) -> np.dtype:
+    return np.dtype(arr.dtype.name if hasattr(arr.dtype, "name")
+                    else arr.dtype)
+
+
+def _pool_parts(field: str, pool):
+    """The named device arrays one logical pool contributes to a block's
+    payload: ``(field, array)`` for a plain pool, plus ``(field_scale,
+    scales)`` for a :class:`QuantPool` — the scales ride INSIDE the
+    per-block payload so the artifact CRC covers them like any other KV
+    byte."""
+    if isinstance(pool, QuantPool):
+        return ((field, pool.q), (field + "_scale", pool.scale))
+    return ((field, pool),)
+
+
+def block_layout(cache: PagedKVCache) -> List[Dict[str, object]]:
+    """THE per-block payload layout, shared by :func:`export_blocks`
+    (payload assembly) and :func:`import_blocks` (payload slicing) so the
+    two can never drift: an ordered segment list, one entry per pool array,
+    layer-major with K before V (and each quantized pool's scale row
+    directly after its int8 data). Each segment describes ONE block's slice
+    of its array — ``array[j]`` — as ``{layer, field, array, shape, dtype,
+    nbytes, offset}`` with ``offset`` its byte position inside the
+    concatenated payload."""
+    segs: List[Dict[str, object]] = []
+    off = 0
+    for layer in range(len(cache.k)):
+        for field, base in (("k", cache.k[layer]), ("v", cache.v[layer])):
+            for name, arr in _pool_parts(field, base):
+                dt = _np_dtype(arr)
+                shape = tuple(int(s) for s in arr.shape[1:])
+                nbytes = int(np.prod(shape)) * dt.itemsize
+                segs.append({"layer": layer, "field": name, "array": arr,
+                             "shape": shape, "dtype": dt, "nbytes": nbytes,
+                             "offset": off})
+                off += nbytes
+    return segs
+
+
+def block_bytes(cache: PagedKVCache) -> int:
+    """One pool block's payload bytes across every layer — K, V, and in
+    the quantized layout their scale rows. Both the export payload size
+    and the /metrics ``kv_bytes_per_block`` gauge."""
+    return sum(int(seg["nbytes"]) for seg in block_layout(cache))
+
+
+def bf16_block_bytes(cache: PagedKVCache) -> int:
+    """What one block of the SAME geometry costs in the bf16 layout —
+    the denominator of the [KV QUANT] capacity ratio. Data elements at
+    2 bytes each, scale rows excluded (the bf16 layout has none). Equal
+    to :func:`block_bytes` on a bf16 cache by construction."""
+    return sum(
+        (int(seg["nbytes"]) // seg["dtype"].itemsize) * 2
+        for seg in block_layout(cache)
+        if not str(seg["field"]).endswith("_scale"))
+
+
 def export_blocks(cache: PagedKVCache, blocks: Sequence[int], out_dir: str,
                   *, length: int, meta: Optional[Dict] = None) -> Dict:
     """Serialize pool rows ``blocks`` device->host into artifact ``out_dir``.
@@ -306,14 +488,13 @@ def export_blocks(cache: PagedKVCache, blocks: Sequence[int], out_dir: str,
         raise ValueError("refusing to export reserved null block 0")
     os.makedirs(out_dir, exist_ok=True)
     idx = np.asarray(list(blocks), np.int32)
-    # One device->host gather per layer per pool, not per block.
-    k_host = [np.asarray(layer[idx]) for layer in cache.k]
-    v_host = [np.asarray(layer[idx]) for layer in cache.v]
+    # One device->host gather per pool array, not per block; payload byte
+    # order is block_layout()'s segment order, the same order import
+    # slices by.
+    hosts = [np.asarray(seg["array"][idx]) for seg in block_layout(cache)]
     files: Dict[str, Dict[str, int]] = {}
     for j in range(len(idx)):
-        payload = b"".join(
-            k_host[layer][j].tobytes() + v_host[layer][j].tobytes()
-            for layer in range(len(k_host)))
+        payload = b"".join(h[j].tobytes() for h in hosts)
         name = _block_file_name(j)
         path = os.path.join(out_dir, name)
         tmp = path + ".tmp"
@@ -409,37 +590,38 @@ def import_blocks(cache: PagedKVCache, art_dir: str,
     if 0 in dest_blocks:
         raise ValueError("refusing to import into reserved null block 0")
     n_layers = len(cache.k)
-    kv_heads = int(cache.k[0].shape[1])
-    bs = int(cache.block_size)
-    hd = int(cache.k[0].shape[3])
-    np_dtype = np.dtype(cache.k[0].dtype.name
-                        if hasattr(cache.k[0].dtype, "name")
-                        else cache.k[0].dtype)
-    per_buf = kv_heads * bs * hd * np_dtype.itemsize
-    k_host = [np.empty((n, kv_heads, bs, hd), np_dtype)
-              for _ in range(n_layers)]
-    v_host = [np.empty((n, kv_heads, bs, hd), np_dtype)
-              for _ in range(n_layers)]
+    layout = block_layout(cache)
+    total = sum(int(seg["nbytes"]) for seg in layout)
+    hosts = {(seg["layer"], seg["field"]):
+             np.empty((n,) + seg["shape"], seg["dtype"]) for seg in layout}
     for j in range(n):
         with open(os.path.join(art_dir, _block_file_name(j)), "rb") as f:
             payload = f.read()
-        if len(payload) != 2 * n_layers * per_buf:
+        if len(payload) != total:
             raise KVBlockIntegrityError(
                 f"block payload {j} has {len(payload)} byte(s), geometry "
-                f"needs {2 * n_layers * per_buf}")
-        for layer in range(n_layers):
-            off = layer * 2 * per_buf
-            k_host[layer][j] = np.frombuffer(
-                payload[off:off + per_buf], np_dtype).reshape(kv_heads, bs, hd)
-            v_host[layer][j] = np.frombuffer(
-                payload[off + per_buf:off + 2 * per_buf],
-                np_dtype).reshape(kv_heads, bs, hd)
+                f"needs {total}")
+        for seg in layout:
+            off = int(seg["offset"])
+            hosts[(seg["layer"], seg["field"])][j] = np.frombuffer(
+                payload[off:off + int(seg["nbytes"])],
+                seg["dtype"]).reshape(seg["shape"])
     idx = jnp.asarray(np.asarray(list(dest_blocks), np.int32))
+
     # Import is rare (restore/handoff, not per token), so plain .at[].set
-    # per layer is fine — no AOT program, no donation games.
-    new_k = tuple(cache.k[layer].at[idx].set(jnp.asarray(k_host[layer]))
+    # per pool array is fine — no AOT program, no donation games.
+    def rebuild(pool, layer, field):
+        if isinstance(pool, QuantPool):
+            return QuantPool(
+                q=pool.q.at[idx].set(
+                    jnp.asarray(hosts[(layer, field)])),
+                scale=pool.scale.at[idx].set(
+                    jnp.asarray(hosts[(layer, field + "_scale")])))
+        return pool.at[idx].set(jnp.asarray(hosts[(layer, field)]))
+
+    new_k = tuple(rebuild(cache.k[layer], layer, "k")
                   for layer in range(n_layers))
-    new_v = tuple(cache.v[layer].at[idx].set(jnp.asarray(v_host[layer]))
+    new_v = tuple(rebuild(cache.v[layer], layer, "v")
                   for layer in range(n_layers))
     return cache.replace(k=new_k, v=new_v), manifest
 
@@ -468,8 +650,18 @@ def cache_shardings(cache, mesh):
     def shard(a):
         return NamedSharding(mesh, _fit_spec(cache_pspec(), a.shape, mesh))
 
+    def shard_pool(p):
+        if isinstance(p, QuantPool):
+            # scale pools are (blocks, kv_heads): same head sharding as
+            # the int8 data, one axis shorter.
+            return QuantPool(
+                q=shard(p.q),
+                scale=NamedSharding(
+                    mesh, _fit_spec(P(None, "tensor"), p.scale.shape, mesh)))
+        return shard(p)
+
     return type(cache)(
-        k=tuple(shard(a) for a in cache.k),
-        v=tuple(shard(a) for a in cache.v),
+        k=tuple(shard_pool(a) for a in cache.k),
+        v=tuple(shard_pool(a) for a in cache.v),
         lengths=NamedSharding(mesh, P(None)),
     )

@@ -115,6 +115,7 @@ from .kv_cache import (
     BLOCK_MANIFEST_NAME,
     KVBlockIntegrityError,
     artifact_bytes,
+    block_bytes,
 )
 from .prefix_cache import PrefixCache
 
@@ -1918,6 +1919,13 @@ class Scheduler:
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count
             out["kv_block_utilization_peak"] = self.max_block_utilization
+            # storage-dtype surface (--kv-dtype): what a block costs in
+            # the selected layout — the bench's blocks-per-byte-budget
+            # numbers read straight off these
+            out["kv_dtype"] = getattr(self.engine, "kv_dtype", "bf16")
+            cache = getattr(self.engine, "cache", None)
+            out["kv_bytes_per_block"] = (
+                block_bytes(cache) if cache is not None else 0)
             if self.prefix_cache is not None:
                 pc = self.prefix_cache
                 out["prefix_lookups"] = pc.lookups

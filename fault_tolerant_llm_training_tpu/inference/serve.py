@@ -38,8 +38,10 @@ from ..ft.signals import SignalFlag
 from ..models.configs import get_config
 from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
+from ..obs.registry import REGISTRY
 from ..utils.config import JOBID
 from ..utils.logging import (
+    AUDIT_KV_QUANT_FMT,
     AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
     AUDIT_SERVE_COMPLETED,
@@ -60,10 +62,19 @@ from .engine import (
     enable_compilation_cache,
     restore_params,
 )
+from .kv_cache import bf16_block_bytes, block_bytes
 from .sampler import AdaptiveK
 from .scheduler import Request, Scheduler
 
 _DEMO_PROMPT = "alpha bravo charlie delta echo"
+
+_M_KV_BYTES_PER_BLOCK = REGISTRY.gauge(
+    "kv_bytes_per_block",
+    "Bytes one paged KV pool block costs in the selected storage dtype "
+    "(every layer's K+V slices; int8 mode includes the scale rows)")
+_M_KV_DTYPE = REGISTRY.gauge(
+    "kv_dtype",
+    "Paged KV pool storage dtype as an info label (kv_dtype{dtype=...} 1)")
 
 
 class _RequestFollower:
@@ -168,6 +179,20 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "max_len-per-slot ring buffers")
     p.add_argument("--kv-block-size", type=int, default=16,
                    help="positions per KV block (paged layout)")
+    p.add_argument("--kv-dtype", default="bf16",
+                   choices=("bf16", "int8"),
+                   help="paged KV pool storage dtype: 'bf16' (plain "
+                        "pools), or 'int8' — blocks stored quantized "
+                        "with per-(block, kv-head) fp32 scales in a "
+                        "parallel scale pool, dequantized inside the "
+                        "attention kernels (fused into the block DMA "
+                        "under --paged-kernel pallas). Roughly halves "
+                        "bytes/block, so the same HBM budget holds ~2x "
+                        "the blocks (see BENCH_kv_quant_cpu.json); "
+                        "greedy argmax ties may flip vs bf16 — the "
+                        "within-dtype bit-exactness contracts (exact "
+                        "spec-verify, burst, spill/handoff) all still "
+                        "hold")
     p.add_argument("--kv-num-blocks", type=int, default=0,
                    help="total KV pool blocks incl. the null block; 0 = "
                         "full reservation parity (slots * max_len worth). "
@@ -409,7 +434,16 @@ def main(argv=None) -> None:
             kv_num_blocks=args.kv_num_blocks or None,
             prefix_cache=not args.no_prefix_cache,
             paged_kernel=args.paged_kernel,
-            prefill_batch=args.prefill_batch, **spec_kwargs)
+            prefill_batch=args.prefill_batch,
+            kv_dtype=args.kv_dtype, **spec_kwargs)
+        if args.kv_layout == "paged":
+            # capacity surface for dashboards: bytes one block costs in
+            # the selected storage dtype (scale rows included) and the
+            # dtype itself as an info label — with kv_blocks_total these
+            # give blocks-per-HBM-budget directly
+            bpb = block_bytes(engine.cache)
+            _M_KV_BYTES_PER_BLOCK.set(bpb)
+            _M_KV_DTYPE.labels(dtype=engine.kv_dtype).set(1)
         if args.spec_k:
             engine.draft_restored_step = draft_step_restored
             logger.info(
@@ -609,6 +643,18 @@ def main(argv=None) -> None:
             occupancy=m["prefill_packed_occupancy"],
             inplace_chunks=m["prefill_inplace_chunks"],
             gather_chunks=m["prefill_gather_chunks"])
+    if engine.kv_layout == "paged":
+        # the --kv-dtype receipt in the drain summary: storage dtype,
+        # bytes one block costs (scale rows included), capacity ratio vs
+        # the bf16 layout at the same geometry (bf16 reads 1.00)
+        bpb = block_bytes(engine.cache)
+        ratio = bf16_block_bytes(engine.cache) / bpb
+        events.emit_audit(
+            logger, AUDIT_KV_QUANT_FMT.format(
+                dtype=engine.kv_dtype, bytes_per_block=bpb, ratio=ratio,
+                blocks_total=engine.num_blocks),
+            "kv_quant", dtype=engine.kv_dtype, bytes_per_block=bpb,
+            ratio=ratio, blocks_total=engine.num_blocks)
     if sched.prefix_cache is not None:
         # hit rate rides the drain-summary audit trail: the receipt an
         # operator greps after a drain shows how much prefill the cache

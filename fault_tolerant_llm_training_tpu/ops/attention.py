@@ -138,12 +138,26 @@ def tree_cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     return out.reshape(b, s_q, h, d).astype(q.dtype)
 
 
-def gather_kv_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray
-                     ) -> jnp.ndarray:
+def dequant_kv(q_vals: jnp.ndarray, scale: jnp.ndarray,
+               out_dtype) -> jnp.ndarray:
+    """THE int8-KV dequant rule, shared verbatim by the gather reference
+    and the Pallas kernels: int8 values times their per-(block, kv_head)
+    fp32 scale, in fp32, cast ONCE to the compute dtype. The gather path
+    applies it after the gather (:func:`gather_kv_blocks`); the Pallas
+    kernels apply it to each block right where its DMA lands in VMEM
+    (ops/paged_attention.py) — so quantized gather-vs-pallas parity
+    reduces to the same online-softmax fp32-reordering tolerance as the
+    bf16 lanes."""
+    return (q_vals.astype(jnp.float32) * scale).astype(out_dtype)
+
+
+def gather_kv_blocks(pool, block_tables: jnp.ndarray,
+                     out_dtype=None) -> jnp.ndarray:
     """Assemble per-slot contiguous KV views from a paged block pool.
 
     pool:         (N, K, bs, D) global block pool (inference/kv_cache.py
                   ``PagedKVCache``); block 0 is the null/scratch block.
+                  An int8 ``QuantPool`` is accepted too — see below.
     block_tables: (B, NB) int32 — slot b's logical block n lives in pool
                   block ``block_tables[b, n]``; unallocated entries are 0.
 
@@ -151,6 +165,14 @@ def gather_kv_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray
     ``b`` is ``pool[block_tables[b, p // bs], :, p % bs]`` — exactly the
     ring buffer's content for every written position, and null-block/stale
     content beyond a slot's length, which the caller's length mask zeroes.
+
+    A quantized pool gathers its int8 blocks AND their per-(block, kv_head)
+    scales through the same table, then dequantizes the gathered view via
+    :func:`dequant_kv` into ``out_dtype`` (the attention compute dtype,
+    default bf16) — dequantize-after-gather, the selectable correctness
+    oracle the fused-dequant Pallas kernels are checked against.
+    ``out_dtype`` is ignored for plain pools: their bytes pass through
+    untouched, preserving the bf16 lanes' bit-exactness story.
 
     The gather is a pure READ of the tables, so the same pool block may
     appear in several slots' rows at once — that is how the prefix cache
@@ -160,7 +182,14 @@ def gather_kv_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray
     into a private block first), so concurrent readers always see
     committed, immutable bytes.
     """
-    g = pool[block_tables]                     # (B, NB, K, bs, D)
+    from ..inference.kv_cache import QuantPool
+    if isinstance(pool, QuantPool):
+        g = pool.q[block_tables]               # (B, NB, K, bs, D) int8
+        sc = pool.scale[block_tables]          # (B, NB, K)
+        g = dequant_kv(g, sc[..., None, None],
+                       jnp.bfloat16 if out_dtype is None else out_dtype)
+    else:
+        g = pool[block_tables]                 # (B, NB, K, bs, D)
     b, nb, k, bs, d = g.shape
     return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(b, k, nb * bs, d)
 
@@ -192,8 +221,9 @@ def paged_cached_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     slot's gathered view equals what its own prefill would have produced —
     the root of the cached-stream bit-exactness tests.
     """
-    return cached_attention(q, gather_kv_blocks(k_pool, block_tables),
-                            gather_kv_blocks(v_pool, block_tables), offsets)
+    return cached_attention(
+        q, gather_kv_blocks(k_pool, block_tables, q.dtype),
+        gather_kv_blocks(v_pool, block_tables, q.dtype), offsets)
 
 
 def paged_tree_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -213,8 +243,9 @@ def paged_tree_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     """
     if impl == "gather":
         return tree_cached_attention(
-            q, gather_kv_blocks(k_pool, block_tables),
-            gather_kv_blocks(v_pool, block_tables), offsets, anc_mask)
+            q, gather_kv_blocks(k_pool, block_tables, q.dtype),
+            gather_kv_blocks(v_pool, block_tables, q.dtype), offsets,
+            anc_mask)
     if impl == "pallas":
         from .paged_attention import paged_tree_chunk_attention
         return paged_tree_chunk_attention(q, k_pool, v_pool, block_tables,

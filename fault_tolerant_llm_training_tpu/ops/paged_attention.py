@@ -51,6 +51,18 @@ the gather path's full-row softmax — equality holds to fp32 accumulation
 tolerance, not bitwise, which is why the engine keeps the gather program
 selectable as the bit-exact reference (``--paged-kernel gather``).
 
+QUANTIZED POOLS (``--kv-dtype int8``): when the pools arrive as
+``kv_cache.QuantPool`` (int8 data + per-(block, kv-head) fp32 scales),
+the scale pools ride along as two extra scalar-prefetch operands —
+(N, K) fp32 in SMEM, looked up with the same dynamic scalar indexing as
+the block table — and each kernel dequantizes the block right after its
+DMA lands in VMEM, with exactly ``ops/attention.py dequant_kv``'s rule
+(fp32 multiply, cast to q dtype). The gather reference dequantizes
+after gather with the same rule, so the two paths still differ only by
+online-softmax accumulation order; scripts/kernel_checks.py
+``check_quantized_decode_parity`` pins the int8-vs-fp32 bound at D=64
+and D=128 over the same adversarial pool matrix.
+
 Runs under ``interpret=True`` off-TPU like every kernel here, so tier-1
 asserts the equivalence on CPU (tests/test_paged_kernel.py).
 """
@@ -81,9 +93,46 @@ DECODE_HEAD_TILE = 1
 CHUNK_HEAD_TILE = 1
 
 
-def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, block_size: int, scale: float,
-                   head_tile: int = 1):
+def _split_quant_pools(k_pool, v_pool):
+    """Unpack possibly-quantized pools for the pallas_call plumbing.
+
+    Returns ``(k_data, v_data, scale_ops)``: the raw (N, K, bs, D) data
+    arrays plus the extra scalar-prefetch operands — ``(k_scale,
+    v_scale)`` (each (N, K) fp32, ridden to SMEM like the block table)
+    when the pools are int8 :class:`QuantPool`s, else ``()``. Mixed
+    quantization of K vs V is rejected: the write path quantizes both
+    or neither.
+    """
+    from ..inference.kv_cache import QuantPool  # lazy: avoid import cycle
+    kq, vq = isinstance(k_pool, QuantPool), isinstance(v_pool, QuantPool)
+    if kq != vq:
+        raise TypeError(f"k/v pools must be quantized together, got "
+                        f"k={type(k_pool).__name__} "
+                        f"v={type(v_pool).__name__}")
+    if not kq:
+        return k_pool, v_pool, ()
+    return k_pool.q, v_pool.q, (k_pool.scale, v_pool.scale)
+
+
+def _dequant_block(blk, scale_ref, pool_blk, kv_head, out_dtype):
+    """Fused dequant at the point the block DMA landed in VMEM.
+
+    ``blk`` is the int8 (bs, D) slice just read through the table;
+    ``scale_ref`` the scalar-prefetched (N, K) fp32 scale pool in SMEM,
+    looked up at (pool block id, kv head) with the same dynamic scalar
+    indexing the table ride-along already uses. MUST match ops/
+    attention.py ``dequant_kv`` exactly — fp32 multiply, cast to the
+    query dtype — so the gather oracle and the fused kernels disagree
+    only by online-softmax accumulation order (the PR 8 tolerance),
+    never by dequant rule.
+    """
+    return (blk.astype(jnp.float32)
+            * scale_ref[pool_blk, kv_head]).astype(out_dtype)
+
+
+def _decode_kernel(tables_ref, offs_ref, *args, block_size: int,
+                   scale: float, head_tile: int = 1,
+                   quantized: bool = False):
     """One (slot b, kv-head tile h, logical block j) grid step.
 
     k_ref/v_ref are the (1, head_tile, bs, D) pool slices the index map
@@ -93,8 +142,24 @@ def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
     head); j == 0 initializes, the last j emits. The head loop is a
     static Python unroll, so ``head_tile == 1`` is instruction-for-
     instruction the pre-knob kernel.
+
+    ``quantized`` (static) reads int8 pool blocks with two extra
+    scalar-prefetch operands — the (N, K) fp32 k/v scale pools — and
+    dequantizes each block right after its DMA (:func:`_dequant_block`).
+    The positional mask is unchanged, so masked int8 garbage (null
+    block, stale tails — including pool rows whose scale[0] entry holds
+    junk from diverted null-row writes) still contributes exactly zero
+    probability: dequant keeps every lane finite (finite int8 × finite
+    fp32 scale), and finite lanes past the boundary underflow to 0.0.
     """
+    if quantized:
+        (ksc_ref, vsc_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = args
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = args
+        ksc_ref = vsc_ref = None
     b = pl.program_id(0)
+    ht_i = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -113,10 +178,16 @@ def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
     def _block():
         for hh in range(head_tile):
             lo, hi = hh * g, (hh + 1) * g
+            kb, vb = k_ref[0, hh], v_ref[0, hh]
+            if quantized:
+                blk = tables_ref[b * pl.num_programs(2) + j]
+                kvh = ht_i * head_tile + hh
+                kb = _dequant_block(kb, ksc_ref, blk, kvh, q_ref.dtype)
+                vb = _dequant_block(vb, vsc_ref, blk, kvh, q_ref.dtype)
             q2 = (q_ref[0, hh].astype(jnp.float32)
                   * (scale * LOG2E)).astype(q_ref.dtype)       # (G, D)
             s = jax.lax.dot_general(                           # (G, bs) fp32
-                q2, k_ref[0, hh], (((1,), (1,)), ((), ())),
+                q2, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             k_pos = j * block_size + jax.lax.broadcasted_iota(
                 jnp.int32, (g, block_size), 1)
@@ -128,7 +199,7 @@ def _decode_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
             l_new = l_prev * alpha + jnp.sum(p, axis=-1)
             acc_scr[lo:hi, :] = (acc_scr[lo:hi, :] * alpha[:, None]
                                  + jax.lax.dot_general(
-                                     p.astype(v_ref.dtype), v_ref[0, hh],
+                                     p.astype(vb.dtype), vb,
                                      (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32))
             m_scr[lo:hi, :] = jnp.broadcast_to(
@@ -164,6 +235,12 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
     Returns (B, 1, H, D), equal to ``paged_cached_attention`` on the same
     operands to fp32 accumulation tolerance.
+
+    k/v_pool may be :class:`~..inference.kv_cache.QuantPool` (int8 data
+    + (N, K) fp32 scales): the scales ride as two extra scalar-prefetch
+    operands and the kernel dequantizes each block in place — same
+    positional masking, same tolerance against the (dequantizing)
+    gather oracle.
     """
     b, s_q, h, d = q.shape
     if s_q != 1:
@@ -171,6 +248,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                          f"S={s_q} (multi-token shapes take "
                          f"paged_chunk_attention — ops/attention.py "
                          f"paged_attention routes)")
+    k_pool, v_pool, scale_ops = _split_quant_pools(k_pool, v_pool)
     n, kv, bs, _ = k_pool.shape
     g = h // kv
     nb = block_tables.shape[1]
@@ -179,24 +257,25 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     tables = block_tables.reshape(-1).astype(jnp.int32)
     offs = offsets.astype(jnp.int32)
     kernel = functools.partial(_decode_kernel, block_size=bs,
-                               scale=1.0 / math.sqrt(d), head_tile=ht)
+                               scale=1.0 / math.sqrt(d), head_tile=ht,
+                               quantized=bool(scale_ops))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=2 + len(scale_ops),
             grid=(b, kv // ht, nb),
             in_specs=[
                 pl.BlockSpec((1, ht, g, d),
-                             lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (bi, hi, 0, 0)),
                 pl.BlockSpec((1, ht, bs, d),
-                             lambda bi, hi, j, t, o: (t[bi * nb + j],
-                                                      hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (t[bi * nb + j],
+                                                          hi, 0, 0)),
                 pl.BlockSpec((1, ht, bs, d),
-                             lambda bi, hi, j, t, o: (t[bi * nb + j],
-                                                      hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (t[bi * nb + j],
+                                                          hi, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, ht, g, d),
-                                   lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, ht, g, d), lambda bi, hi, j, t, *pref: (bi, hi, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((ht * g, _STAT_LANES), jnp.float32),  # m
                 pltpu.VMEM((ht * g, _STAT_LANES), jnp.float32),  # l
@@ -205,13 +284,13 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, g, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(tables, offs, qg, k_pool, v_pool)
+    )(tables, offs, *scale_ops, qg, k_pool, v_pool)
     return out.reshape(b, 1, h, d)
 
 
-def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, block_size: int, group: int,
-                  s_q: int, scale: float, head_tile: int = 1):
+def _chunk_kernel(tables_ref, offs_ref, *args, block_size: int, group: int,
+                  s_q: int, scale: float, head_tile: int = 1,
+                  quantized: bool = False):
     """One (slot b, kv-head tile h, logical block j) grid step, S > 1 rows.
 
     The q block is the chunk's S*G rows for each tiled kv head, s-major:
@@ -222,9 +301,17 @@ def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
     the block's k_pos row — and the wholesale block skip keys off the
     LAST row's boundary (a block any row can see must run; rows that
     can't see it get every lane masked, exp2 underflows to 0.0 exactly,
-    their carry is untouched).
+    their carry is untouched). ``quantized`` fuses the int8 block
+    dequant exactly as in :func:`_decode_kernel`.
     """
+    if quantized:
+        (ksc_ref, vsc_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = args
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = args
+        ksc_ref = vsc_ref = None
     b = pl.program_id(0)
+    ht_i = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -240,10 +327,16 @@ def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
     def _block():
         for hh in range(head_tile):
             lo, hi = hh * rows, (hh + 1) * rows
+            kb, vb = k_ref[0, hh], v_ref[0, hh]
+            if quantized:
+                blk = tables_ref[b * pl.num_programs(2) + j]
+                kvh = ht_i * head_tile + hh
+                kb = _dequant_block(kb, ksc_ref, blk, kvh, q_ref.dtype)
+                vb = _dequant_block(vb, vsc_ref, blk, kvh, q_ref.dtype)
             q2 = (q_ref[0, hh].astype(jnp.float32)
                   * (scale * LOG2E)).astype(q_ref.dtype)       # (rows, D)
             s = jax.lax.dot_general(                           # (rows, bs)
-                q2, k_ref[0, hh], (((1,), (1,)), ((), ())),
+                q2, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             k_pos = j * block_size + jax.lax.broadcasted_iota(
                 jnp.int32, (rows, block_size), 1)
@@ -257,7 +350,7 @@ def _chunk_kernel(tables_ref, offs_ref, q_ref, k_ref, v_ref, o_ref,
             l_new = l_prev * alpha + jnp.sum(p, axis=-1)
             acc_scr[lo:hi, :] = (acc_scr[lo:hi, :] * alpha[:, None]
                                  + jax.lax.dot_general(
-                                     p.astype(v_ref.dtype), v_ref[0, hh],
+                                     p.astype(vb.dtype), vb,
                                      (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32))
             m_scr[lo:hi, :] = jnp.broadcast_to(
@@ -303,6 +396,7 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     if s_q < 2:
         raise ValueError(f"paged_chunk_attention wants S > 1, got S={s_q} "
                          f"(S=1 is paged_decode_attention's shape)")
+    k_pool, v_pool, scale_ops = _split_quant_pools(k_pool, v_pool)
     n, kv, bs, _ = k_pool.shape
     g = h // kv
     nb = block_tables.shape[1]
@@ -317,24 +411,25 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     offs = offsets.astype(jnp.int32)
     kernel = functools.partial(_chunk_kernel, block_size=bs, group=g,
                                s_q=s_q, scale=1.0 / math.sqrt(d),
-                               head_tile=ht)
+                               head_tile=ht, quantized=bool(scale_ops))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=2 + len(scale_ops),
             grid=(b, kv // ht, nb),
             in_specs=[
                 pl.BlockSpec((1, ht, rows, d),
-                             lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (bi, hi, 0, 0)),
                 pl.BlockSpec((1, ht, bs, d),
-                             lambda bi, hi, j, t, o: (t[bi * nb + j],
-                                                      hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (t[bi * nb + j],
+                                                          hi, 0, 0)),
                 pl.BlockSpec((1, ht, bs, d),
-                             lambda bi, hi, j, t, o: (t[bi * nb + j],
-                                                      hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (t[bi * nb + j],
+                                                          hi, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, ht, rows, d),
-                                   lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, ht, rows, d),
+                lambda bi, hi, j, t, *pref: (bi, hi, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((ht * rows, _STAT_LANES), jnp.float32),  # m
                 pltpu.VMEM((ht * rows, _STAT_LANES), jnp.float32),  # l
@@ -343,14 +438,13 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, rows, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(tables, offs, qr, k_pool, v_pool)
+    )(tables, offs, *scale_ops, qr, k_pool, v_pool)
     return (out.reshape(b, kv, s_q, g, d)
             .transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, d))
 
 
-def _tree_kernel(tables_ref, offs_ref, q_ref, anc_ref, k_ref, v_ref, o_ref,
-                 m_scr, l_scr, acc_scr, *, block_size: int, group: int,
-                 s_q: int, scale: float):
+def _tree_kernel(tables_ref, offs_ref, *args, block_size: int, group: int,
+                 s_q: int, scale: float, quantized: bool = False):
     """:func:`_chunk_kernel` with the causal rule swapped for the tree's
     ANCESTOR rule (tree-verify: the q rows are one flattened token tree).
 
@@ -364,9 +458,18 @@ def _tree_kernel(tables_ref, offs_ref, q_ref, anc_ref, k_ref, v_ref, o_ref,
     to exact zero probability like every other masked lane; the block
     skip and the online-softmax carry are the chunk kernel's unchanged.
     Every row sees at least its own key (``anc[r, r]`` is set), so l > 0
-    at emit.
+    at emit. ``quantized`` fuses the int8 block dequant exactly as in
+    :func:`_decode_kernel` (head_tile is 1 here: program_id(1) IS the
+    kv head).
     """
+    if quantized:
+        (ksc_ref, vsc_ref, q_ref, anc_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = args
+    else:
+        q_ref, anc_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = args
+        ksc_ref = vsc_ref = None
     b = pl.program_id(0)
+    kvh = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -380,10 +483,15 @@ def _tree_kernel(tables_ref, offs_ref, q_ref, anc_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * block_size <= offset + (s_q - 1))
     def _block():
+        kb, vb = k_ref[0, 0], v_ref[0, 0]
+        if quantized:
+            blk = tables_ref[b * pl.num_programs(2) + j]
+            kb = _dequant_block(kb, ksc_ref, blk, kvh, q_ref.dtype)
+            vb = _dequant_block(vb, vsc_ref, blk, kvh, q_ref.dtype)
         q2 = (q_ref[0, 0].astype(jnp.float32)
               * (scale * LOG2E)).astype(q_ref.dtype)       # (rows, D)
         s = jax.lax.dot_general(                           # (rows, bs) fp32
-            q2, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            q2, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         k_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (rows, block_size), 1)
@@ -400,7 +508,7 @@ def _tree_kernel(tables_ref, offs_ref, q_ref, anc_ref, k_ref, v_ref, o_ref,
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_scr[...] = (acc_scr[...] * alpha[:, None]
                         + jax.lax.dot_general(
-                            p.astype(v_ref.dtype), v_ref[0, 0],
+                            p.astype(vb.dtype), vb,
                             (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32))
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
@@ -442,6 +550,7 @@ def paged_tree_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     if anc_mask.shape != (s_q, s_q):
         raise ValueError(f"anc_mask must be (S, S) = ({s_q}, {s_q}), got "
                          f"{anc_mask.shape}")
+    k_pool, v_pool, scale_ops = _split_quant_pools(k_pool, v_pool)
     n, kv, bs, _ = k_pool.shape
     g = h // kv
     nb = block_tables.shape[1]
@@ -452,26 +561,28 @@ def paged_tree_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     offs = offsets.astype(jnp.int32)
     anc = anc_mask.astype(jnp.int32)
     kernel = functools.partial(_tree_kernel, block_size=bs, group=g,
-                               s_q=s_q, scale=1.0 / math.sqrt(d))
+                               s_q=s_q, scale=1.0 / math.sqrt(d),
+                               quantized=bool(scale_ops))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=2 + len(scale_ops),
             grid=(b, kv, nb),
             in_specs=[
                 pl.BlockSpec((1, 1, rows, d),
-                             lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (bi, hi, 0, 0)),
                 pl.BlockSpec((s_q, s_q),
-                             lambda bi, hi, j, t, o: (0, 0)),
+                             lambda bi, hi, j, t, *pref: (0, 0)),
                 pl.BlockSpec((1, 1, bs, d),
-                             lambda bi, hi, j, t, o: (t[bi * nb + j],
-                                                      hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (t[bi * nb + j],
+                                                          hi, 0, 0)),
                 pl.BlockSpec((1, 1, bs, d),
-                             lambda bi, hi, j, t, o: (t[bi * nb + j],
-                                                      hi, 0, 0)),
+                             lambda bi, hi, j, t, *pref: (t[bi * nb + j],
+                                                          hi, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, rows, d),
-                                   lambda bi, hi, j, t, o: (bi, hi, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, 1, rows, d),
+                lambda bi, hi, j, t, *pref: (bi, hi, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((rows, _STAT_LANES), jnp.float32),  # m
                 pltpu.VMEM((rows, _STAT_LANES), jnp.float32),  # l
@@ -480,6 +591,6 @@ def paged_tree_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, rows, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(tables, offs, qr, anc, k_pool, v_pool)
+    )(tables, offs, *scale_ops, qr, anc, k_pool, v_pool)
     return (out.reshape(b, kv, s_q, g, d)
             .transpose(0, 2, 1, 3, 4).reshape(b, s_q, h, d))

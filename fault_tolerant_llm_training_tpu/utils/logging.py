@@ -148,3 +148,13 @@ AUDIT_KV_TIER_FMT = ("[KV TIER] Spill {action} request {id}: {blocks} "
                      "block(s), {bytes} byte(s) (tier={tier})")
 AUDIT_HANDOFF_FMT = ("[HANDOFF] Block-shipment {action} request {id} "
                      "(gen {gen}): {blocks} block(s), {detail}")
+
+# --- Quantized-KV audit trail (inference/serve.py, inference/fleet.py) —
+# the drain summary's --kv-dtype receipt: what the pool stored its blocks
+# as, the bytes one block costs (scale rows included), and the capacity
+# ratio against the bf16 layout at the same geometry. Emitted for every
+# paged engine (bf16 reads ratio 1.00), so the line is always on the
+# grep surface; frozen in tests/test_audit_contract.py like the rest. ---
+AUDIT_KV_QUANT_FMT = ("[KV QUANT] dtype={dtype} | {bytes_per_block} "
+                      "B/block ({ratio:.2f}x vs bf16) | {blocks_total} "
+                      "pool block(s)")

@@ -58,7 +58,15 @@
 #    spill-off on the late short request's TTFT (> 1x; the magnitude is
 #    machine-dependent), at least one export+restore round-trip actually
 #    happened with zero CRC rejects, and both modes' streams bit-match
-#    the unconstrained reference.
+#    the unconstrained reference;
+# 9. kv_quant bench — re-runs the int8-vs-bf16 fixed-byte-budget
+#    scenario and pins the BENCH_kv_quant_cpu.json bars: int8
+#    kv_blocks_total >= 1.9x bf16 at the same pool bytes (and the
+#    per-block byte ratio itself >= 1.9x), the concurrency gain at the
+#    admission gate >= 1x, and the held-out-shard perplexity shift
+#    stays under a 5% ceiling (greedy flips are recorded, never
+#    pinned); then compiles the fused-dequant parity check at D=64 and
+#    D=128 over the adversarial pool matrix and requires it green.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -329,4 +337,48 @@ print(f"ok: spill-on {got['value']}x spill-off on late-request TTFT "
       f"restore(s), 0 rejects, streams bit-exact vs unconstrained")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill)"
+echo "== kv_quant bench vs committed receipt"
+python scripts/decode_bench.py --scenario kv_quant \
+    --out "$WORK/bench_kv_quant.json"
+python - "$WORK/bench_kv_quant.json" BENCH_kv_quant_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+PPL_REL_CEIL = 0.05
+assert got["blocks_ratio"] >= 1.9, (
+    f"int8 pool holds only {got['blocks_ratio']}x the bf16 blocks at the "
+    f"same byte budget (>= 1.9x acceptance bar)")
+assert got["bytes_per_block_ratio"] >= 1.9, (
+    f"int8 bytes/block ratio {got['bytes_per_block_ratio']} < 1.9x — the "
+    f"scale-pool overhead grew")
+assert got["concurrency_gain"] >= 1.0, (
+    f"extra int8 blocks bought no concurrency at the admission gate "
+    f"({got['concurrency_gain']}x)")
+ppl = got["held_out_perplexity"]
+assert abs(ppl["perplexity_rel_delta"]) <= PPL_REL_CEIL, (
+    f"held-out perplexity moved {ppl['perplexity_rel_delta']:+.4f} "
+    f"under int8 KV (|delta| ceiling {PPL_REL_CEIL})")
+assert want["blocks_ratio"] >= 1.9, "committed receipt is stale"
+print(f"ok: int8 {got['blocks_ratio']}x blocks at "
+      f"{got['pool_budget_bytes']} pool bytes (bytes/block "
+      f"{got['bytes_per_block_ratio']}x), concurrency "
+      f"{got['concurrency_gain']}x, held-out perplexity delta "
+      f"{ppl['perplexity_rel_delta']:+.4f} (|ceil| {PPL_REL_CEIL})")
+EOF
+
+echo "== fused-dequant parity check (int8 KV, D=64/128)"
+python - <<'EOF'
+import sys
+
+sys.path.insert(0, ".")
+from scripts.kernel_checks import check_quantized_decode_parity
+
+ok = check_quantized_decode_parity()
+ok &= check_quantized_decode_parity(h=8, kv=4, d=128)
+assert ok, "quantized decode parity check failed"
+print("ok: fused-dequant kernels within error bounds at D=64 and D=128")
+EOF
+
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity)"

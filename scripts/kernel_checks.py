@@ -394,6 +394,105 @@ def check_tree_verify_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64,
     return ok
 
 
+def _quantize_pool(np_pool):
+    """Per-(block, kv-head) symmetric int8, the same rule the paged write
+    path applies at local position 0 (inference/kv_cache.py)."""
+    from fault_tolerant_llm_training_tpu.inference.kv_cache import (
+        KV_QUANT_QMAX,
+        QuantPool,
+    )
+
+    a = np.asarray(np_pool, np.float32)
+    amax = np.max(np.abs(a), axis=(2, 3))
+    scale = np.where(amax > 0, amax / KV_QUANT_QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(a / scale[:, :, None, None]),
+                -KV_QUANT_QMAX, KV_QUANT_QMAX).astype(np.int8)
+    return QuantPool(q=jnp.asarray(q), scale=jnp.asarray(scale))
+
+
+def check_quantized_decode_parity(slots=8, kv=2, h=4, bs=16, nb=16, d=64,
+                                  dtype=jnp.bfloat16):
+    """int8 KV pools, compiled: the fused-dequant pallas kernels (S=1
+    decode, S>1 chunk, tree-verify) vs the int8 gather oracle must agree
+    to kernel-numerics tolerance, and the int8 path vs the UNQUANTIZED
+    bf16 gather reference must stay inside the per-block-scale
+    quantization error bound — over the same adversarial pool matrix as
+    the bf16 checks (garbage null block, freed tails at block 0, stale
+    entries aimed at orphan blocks, shared/COW prefix rows, offsets ON
+    and STRADDLING block boundaries)."""
+    from fault_tolerant_llm_training_tpu.inference.engine import TreeShape
+    from fault_tolerant_llm_training_tpu.ops.attention import (
+        paged_cached_attention,
+        paged_tree_attention,
+    )
+    from fault_tolerant_llm_training_tpu.ops.paged_attention import (
+        paged_chunk_attention,
+        paged_decode_attention,
+    )
+
+    shape = TreeShape((2, 2, 1))
+    s_q = shape.size
+    anc = jnp.asarray(shape.anc_mask)
+    rng = np.random.default_rng(7)
+    n_pool = slots * nb + 4
+    np_k = rng.standard_normal((n_pool, kv, bs, d))
+    np_v = rng.standard_normal((n_pool, kv, bs, d))
+    perm = rng.permutation(np.arange(1, slots * nb + 1))
+    tables = perm.reshape(slots, nb).astype(np.int32)
+    offsets = rng.integers(s_q, nb * bs - s_q, size=slots).astype(np.int32)
+    offsets[0] = 2 * bs                     # ON a block boundary
+    offsets[1] = bs - s_q // 2              # chunk/window STRADDLES one
+    for b in range(slots):                  # freed tails back at block 0
+        tables[b, (int(offsets[b]) + s_q - 1) // bs + 1:] = 0
+    tables[2, -1] = n_pool - 1              # stale entry at an orphan block
+    tables[3, :2] = tables[2, :2]           # shared (COW-parent) rows
+    pool_k, pool_v = jnp.asarray(np_k, dtype), jnp.asarray(np_v, dtype)
+    qk, qv = _quantize_pool(np_k), _quantize_pool(np_v)
+    jtables, joffsets = jnp.asarray(tables), jnp.asarray(offsets)
+    q1 = jnp.asarray(rng.standard_normal((slots, 1, h, d)), dtype)
+    qs = jnp.asarray(rng.standard_normal((slots, s_q, h, d)), dtype)
+
+    def rel(got, want):
+        e = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                  - want.astype(jnp.float32))))
+        s = float(jnp.max(jnp.abs(want.astype(jnp.float32)))) or 1.0
+        return e / s
+
+    report, ok = {}, True
+    # (path, fused-on-int8, oracle-on-int8, bf16 reference)
+    paths = [
+        ("decode",
+         jax.jit(paged_decode_attention)(q1, qk, qv, jtables, joffsets),
+         jax.jit(paged_cached_attention)(q1, qk, qv, jtables, joffsets),
+         jax.jit(paged_cached_attention)(q1, pool_k, pool_v, jtables,
+                                         joffsets)),
+        ("chunk",
+         jax.jit(paged_chunk_attention)(qs, qk, qv, jtables, joffsets),
+         jax.jit(paged_cached_attention)(qs, qk, qv, jtables, joffsets),
+         jax.jit(paged_cached_attention)(qs, pool_k, pool_v, jtables,
+                                         joffsets)),
+        ("tree",
+         jax.jit(lambda *a: paged_tree_attention(*a, anc, impl="pallas"))(
+             qs, qk, qv, jtables, joffsets),
+         jax.jit(lambda *a: paged_tree_attention(*a, anc, impl="gather"))(
+             qs, qk, qv, jtables, joffsets),
+         jax.jit(lambda *a: paged_tree_attention(*a, anc, impl="gather"))(
+             qs, pool_k, pool_v, jtables, joffsets)),
+    ]
+    for name, fused, oracle, ref16 in paths:
+        r_oracle = rel(fused, oracle)   # kernel numerics, same int8 bytes
+        r_quant = rel(fused, ref16)     # quantization error itself
+        report[f"rel_{name}_vs_int8_oracle"] = r_oracle
+        report[f"rel_{name}_vs_bf16_ref"] = r_quant
+        ok &= r_oracle < 2e-2 and r_quant < 5e-2
+    print(json.dumps({
+        "check": (f"quantized_decode_parity slots={slots} kv={kv} h={h} "
+                  f"bs={bs} nb={nb} d={d}"),
+        **{k: round(v, 6) for k, v in report.items()}, "ok": ok,
+    }), flush=True)
+    return ok
+
+
 def main():
     ok = True
     ok &= check_flash_parity(2048, 12, 12, 64)   # resident, bench shape
@@ -416,6 +515,8 @@ def main():
     ok &= check_paged_chunk_parity(h=8, kv=4, d=128)        # flagship width
     ok &= check_tree_verify_parity()                        # tree spec, D=64
     ok &= check_tree_verify_parity(h=8, kv=4, d=128)        # flagship width
+    ok &= check_quantized_decode_parity()                   # int8 KV, D=64
+    ok &= check_quantized_decode_parity(h=8, kv=4, d=128)   # flagship width
     sys.exit(0 if ok else 1)
 
 

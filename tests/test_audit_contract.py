@@ -122,6 +122,9 @@ FROZEN = {
     "AUDIT_HANDOFF_FMT":
         "[HANDOFF] Block-shipment {action} request {id} (gen {gen}): "
         "{blocks} block(s), {detail}",
+    "AUDIT_KV_QUANT_FMT":
+        "[KV QUANT] dtype={dtype} | {bytes_per_block} B/block "
+        "({ratio:.2f}x vs bf16) | {blocks_total} pool block(s)",
 }
 
 
