@@ -23,12 +23,16 @@ exposes one hook per injection site:
 - :meth:`on_reload` — deploy/reload.py, keyed by reload ordinal (1 = the
   first swap): ``reload_signal`` delivers a real SIGUSR1 in the middle of
   a hot weight swap;
-- :meth:`on_handoff` / :meth:`on_spill` — the tiered-KV block artifacts
-  (inference/scheduler.py spill tier, inference/fleet.py ``--handoff``
-  drain), keyed by export ordinal: ``handoff_corrupt`` / ``spill_corrupt``
-  flip one payload byte AFTER the artifact's CRC manifest commits, so the
-  verify-before-import must reject it and the request must degrade to
-  committed-prefix replay.
+- :meth:`on_handoff` / :meth:`on_spill` / :meth:`on_ship` — the tiered-KV
+  block artifacts (inference/scheduler.py spill tier and incremental
+  prefill shipments, inference/fleet.py ``--handoff`` drain), keyed by
+  export ordinal: ``handoff_corrupt`` / ``spill_corrupt`` /
+  ``ship_corrupt`` flip one payload byte AFTER the artifact's CRC
+  manifest commits, so the verify-before-import must reject it and the
+  request must degrade to committed-prefix replay;
+- :meth:`on_prefill_chunk` — the prefill-role scheduler's chunk-commit
+  boundary, keyed by completed-chunk ordinal: ``prefill_kill`` SIGKILLs
+  the prefill engine mid-prompt.
 
 Trigger kinds beyond ``step=N`` (chaos/schedule.py): ``t=DUR`` entries
 fire at the first injection-site visit after DUR has elapsed since this
@@ -229,6 +233,18 @@ class ChaosInjector:
                        signum=int(_signal.SIGKILL), fleet=True)
             os.kill(os.getpid(), _signal.SIGKILL)
 
+    def on_prefill_chunk(self, ordinal: int) -> None:
+        """Prefill-chunk hook (inference/scheduler.py), keyed by the
+        host's completed-prefill-chunk ordinal (0 = right after the first
+        chunk commits): ``prefill_kill`` SIGKILLs a prefill-role host
+        between chunk commits — shipments stop mid-prompt and the router
+        must re-prefill the request on a peer. Same audit-before-death
+        ordering as ``host_kill``."""
+        for e in self._pending(("prefill_kill",), ordinal):
+            self._fire(e, at_step=ordinal,
+                       signum=int(_signal.SIGKILL), prefill=True)
+            os.kill(os.getpid(), _signal.SIGKILL)
+
     def on_heartbeat(self, iteration: int) -> None:
         """Lease-renewal hook (inference/fleet.py), keyed by loop
         iteration: ``heartbeat_delay`` sleeps before the renewal write, so
@@ -294,6 +310,17 @@ class ChaosInjector:
         return self._corrupt_artifact(
             "handoff_corrupt", artifact_dir, ordinal,
             what=f"handoff artifact {ordinal}")
+
+    def on_ship(self, artifact_dir: str, ordinal: int = 0) -> Optional[str]:
+        """Block-shipment hook (disaggregated prefill, called AFTER one
+        incremental shipment's manifest commits, keyed by this host's
+        ship-export ordinal): ``ship_corrupt`` flips one payload byte with
+        the manifest spared — the router's verify must CRC-reject exactly
+        this shipment and the decode admission degrades to
+        committed-prefix replay. Returns the corrupted path."""
+        return self._corrupt_artifact(
+            "ship_corrupt", artifact_dir, ordinal,
+            what=f"block shipment {ordinal}")
 
     def on_spill(self, artifact_dir: str, ordinal: int = 0) -> Optional[str]:
         """Spill-tier hook (inference/scheduler.py), called AFTER a

@@ -70,6 +70,16 @@ handoff_corrupt flip a payload byte in a just-exported block-shipment
 spill_corrupt   flip a payload byte in a just-written KV spill artifact,
                 keyed by spill ordinal — the restore's CRC verify must
                 reject it and fall back to a replay re-admission
+prefill_kill    SIGKILL a prefill-role fleet host between prefill chunk
+                commits (keyed by completed-chunk ordinal, 0 = after the
+                first chunk) — no drain, shipments stop mid-prompt: the
+                router must re-prefill the request on a peer and the
+                dead host's partial shipments must never be imported
+ship_corrupt    flip a payload byte in the Nth block shipment a prefill
+                host exports (keyed by ship ordinal, manifest spared) —
+                the router's verify must CRC-reject exactly that shipment
+                and hand the request to decode as a committed-prefix
+                replay instead
 ==============  ============================================================
 
 Steps are *global* training steps, so an entry in the past at resume time
@@ -98,6 +108,8 @@ FAULTS = {
     "heartbeat_delay": 2.0,
     "handoff_corrupt": None,
     "spill_corrupt": None,
+    "prefill_kill": None,
+    "ship_corrupt": None,
 }
 
 # The serving loop has no training steps, prefetcher or KV agreement: only
@@ -109,7 +121,8 @@ SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal", "spill_corrupt")
 # giving only that host's process the entry (each host is a separate OS
 # process with its own schedule, so @rank= is unnecessary there).
 FLEET_FAULTS = ("sigusr1", "sigterm", "host_kill", "heartbeat_delay",
-                "handoff_corrupt", "spill_corrupt")
+                "handoff_corrupt", "spill_corrupt", "prefill_kill",
+                "ship_corrupt")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ENTRY_RE = re.compile(

@@ -120,6 +120,8 @@ class HostLease:
     block_size: int
     pid: int
     age: float               # reader's now - t
+    role: str = "both"       # engine role: both | prefill | decode
+    kv_dtype: str = "bf16"   # paged pool storage dtype (ship geometry)
 
     @property
     def live(self) -> bool:
@@ -156,15 +158,20 @@ class LeaseRegistry:
 
     # ------------------------------------------------------------- holder side
     def renew(self, slots_free: int, blocks_free: int,
-              block_size: int) -> bool:
+              block_size: int, role: str = "both",
+              kv_dtype: str = "bf16") -> bool:
         """Stamp a fresh lease; returns False on a bounded-deadline failure
-        (the caller counts a failed renewal toward its self-fence)."""
+        (the caller counts a failed renewal toward its self-fence).
+        ``role``/``kv_dtype`` ride in the lease value so the router can
+        place by engine role and reject mixed-dtype prefill->decode pairs
+        at placement time (shipped blocks are geometry-checked artifacts)."""
         if self.host_id is None:
             raise ValueError("renew() requires a host_id")
         value = json.dumps({
             "t": self.clock(), "ttl": self.ttl,
             "slots_free": int(slots_free), "blocks_free": int(blocks_free),
             "block_size": int(block_size), "pid": os.getpid(),
+            "role": str(role), "kv_dtype": str(kv_dtype),
         })
         try:
             self._retry(
@@ -217,7 +224,9 @@ class LeaseRegistry:
                     blocks_free=int(d.get("blocks_free", 0)),
                     block_size=int(d.get("block_size", 1)),
                     pid=int(d.get("pid", 0)),
-                    age=max(0.0, now - float(d["t"])))
+                    age=max(0.0, now - float(d["t"])),
+                    role=str(d.get("role", "both")),
+                    kv_dtype=str(d.get("kv_dtype", "bf16")))
             except (ValueError, KeyError, TypeError):
                 continue  # torn/garbage lease reads as absent, not as a crash
         return out

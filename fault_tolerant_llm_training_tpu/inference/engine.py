@@ -116,6 +116,7 @@ from .kv_cache import (
     cache_shardings,
     copy_kv_block,
     export_blocks,
+    import_block_batch,
     import_blocks,
     init_cache,
     init_paged_cache,
@@ -1249,6 +1250,45 @@ class InferenceEngine:
             lengths=cache.lengths.at[slot].set(
                 np.int32(manifest["length"])))
         return manifest
+
+    def import_pool_blocks(self, art_dir: str, dest_blocks) -> dict:
+        """Verify artifact ``art_dir`` and scatter it into pool rows
+        ``dest_blocks`` WITHOUT touching any slot's fill count — the
+        disaggregated decode import sets the length once, after every
+        shipment is resident, via :meth:`set_slot_length`. Raises
+        ``KVBlockIntegrityError`` with the cache untouched on any
+        mismatch. Returns the manifest."""
+        if self.kv_layout != "paged":
+            raise ValueError("block import requires the paged KV layout")
+        cache, manifest = import_blocks(self.cache, art_dir, dest_blocks)
+        self.cache = cache
+        return manifest
+
+    def import_pool_block_batch(self, parts) -> list:
+        """Verify every artifact in ``parts`` ((art_dir, dest_blocks)
+        pairs) and land them all in ONE scatter per pool array, WITHOUT
+        touching any slot's fill count — the disaggregated decode
+        admission imports a request's whole shipment train as a single
+        device write, then sets the length once via
+        :meth:`set_slot_length`. Raises ``KVBlockIntegrityError`` with
+        the cache untouched on any mismatch (verification of every
+        payload precedes the first device write). Returns the manifests
+        in ``parts`` order."""
+        if self.kv_layout != "paged":
+            raise ValueError("block import requires the paged KV layout")
+        cache, manifests = import_block_batch(self.cache, parts)
+        self.cache = cache
+        return manifests
+
+    def set_slot_length(self, slot: int, length: int) -> None:
+        """Set ``slot``'s fill count directly (paged only) — the decode
+        side of a disaggregated admission, after every shipment's blocks
+        are resident, so the first decode round attends to the full
+        committed prefix."""
+        if self.kv_layout != "paged":
+            raise ValueError("slot length set requires the paged KV layout")
+        self.cache = self.cache.replace(
+            lengths=self.cache.lengths.at[slot].set(np.int32(int(length))))
 
     def _stream_chunks(self, draft: bool, row, ids, slot, temperature,
                        top_p, seed, stop_check, on_chunk, start_pos=0):

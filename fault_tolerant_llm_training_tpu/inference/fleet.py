@@ -40,6 +40,19 @@ survivor when the artifact CRC-verifies, and by the ordinary
 committed-prefix replay when it is missing, torn, or rejected — a SIGKILL
 leaves no artifact and naturally takes the replay path, so the handoff
 fast path adds no new way to lose a request.
+
+``--role prefill|decode`` splits the host into one side of the
+disaggregated pipeline (DistServe/Splitwise over the artifact path): a
+prefill host admits ``assign``/``migrate`` records, exports each committed
+chunk's blocks as an incremental shipment (``ship`` journal records, chaos
+``ship_corrupt`` keyed by export ordinal) and journals ``prefill_done``; a
+decode host admits the router's ``decode`` records, imports the verified
+shipments into its own pool (prefix-cache-deduped) and decodes bit-exactly
+from the committed offset. The role and the pool's kv-dtype ride in the
+heartbeat lease, so the router places by role and rejects mixed-dtype
+prefill->decode pairs at placement time. Death of either side is the
+ordinary fence/migrate machinery; a rejected or stale shipment degrades to
+the committed-prefix replay on whatever host holds the request.
 """
 
 import argparse
@@ -56,6 +69,7 @@ from ..ft.signals import SignalFlag
 from ..models.configs import get_config
 from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
+from ..obs.registry import REGISTRY
 from ..utils.logging import (
     AUDIT_FLEET_JOIN_FMT,
     AUDIT_FLEET_LEAVE_FMT,
@@ -77,6 +91,11 @@ from .kv_cache import bf16_block_bytes, block_bytes
 from .scheduler import Request, Scheduler
 
 ROUTER_JOURNAL = "router.jsonl"
+
+_M_ENGINE_ROLE = REGISTRY.gauge(
+    "engine_role",
+    "Disaggregated serving role as an info label "
+    "(engine_role{engine_role=...} 1)")
 
 
 class _AssignmentFollower:
@@ -126,7 +145,7 @@ class _AssignmentFollower:
                 rec = json.loads(line)
             except ValueError:
                 continue
-            if (rec.get("kind") in ("assign", "migrate")
+            if (rec.get("kind") in ("assign", "migrate", "decode")
                     and rec.get("host") == self.host_id):
                 out.append(rec)
         return out
@@ -157,6 +176,12 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
     p.add_argument("--layer-impl", default="loop", choices=("loop", "scan"))
     p.add_argument("--slots", type=int, default=2)
     p.add_argument("--max-len", type=int, default=0)
+    p.add_argument("--prefill-buckets", default="",
+                   help="comma-separated AOT prefill lengths (default: "
+                        "power-of-two ladder); the largest bucket is the "
+                        "prefill CHUNK size, so a prefill-role host ships "
+                        "one incremental block artifact per largest-"
+                        "bucket's worth of committed prompt")
     p.add_argument("--kv-block-size", type=int, default=16)
     p.add_argument("--kv-num-blocks", type=int, default=0)
     p.add_argument("--kv-dtype", default="bf16",
@@ -191,8 +216,9 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                    help="fault schedule: host_kill / sigusr1 / sigterm "
                         "keyed by decode iteration (serve.py convention); "
                         "heartbeat_delay keyed by fleet loop iteration; "
-                        "handoff_corrupt / spill_corrupt keyed by export "
-                        "ordinal")
+                        "handoff_corrupt / spill_corrupt / ship_corrupt "
+                        "keyed by export ordinal; prefill_kill keyed by "
+                        "completed-prefill-chunk ordinal")
     p.add_argument("--handoff", action="store_true",
                    help="on a signal drain, ship in-flight requests' "
                         "committed KV blocks as checksummed artifacts "
@@ -204,6 +230,16 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                         "exhaustion, preempt the coldest request's blocks "
                         "into checksummed artifacts under this directory "
                         "and restore on demand")
+    p.add_argument("--role", default="both",
+                   choices=("both", "prefill", "decode"),
+                   help="disaggregated pipeline role: 'prefill' admits "
+                        "assign/migrate records, ships each committed "
+                        "chunk's KV blocks as CRC'd artifacts and journals "
+                        "prefill_done; 'decode' admits the router's "
+                        "'decode' records and imports the verified "
+                        "shipments before decoding bit-exactly from the "
+                        "committed offset; 'both' (default) is the "
+                        "colocated host")
     return p.parse_args(argv)
 
 
@@ -243,10 +279,13 @@ def main(argv=None) -> None:
         vocab = args.vocab_size or tokenizer.vocab_size
         cfg = get_config(args.model, vocab_size=vocab,
                          layer_impl=args.layer_impl)
+        buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
+                   if args.prefill_buckets else None)
         engine = InferenceEngine.from_checkpoint(
             args.checkpoint_path, args.checkpoint_job_id, cfg,
             step=args.step, slots=args.slots,
-            max_len=args.max_len or None, kv_layout="paged",
+            max_len=args.max_len or None, prefill_buckets=buckets,
+            kv_layout="paged",
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks or None,
             paged_kernel=args.paged_kernel,
@@ -257,13 +296,34 @@ def main(argv=None) -> None:
                 slots=args.slots),
             "ready", step=engine.restored_step, slots=args.slots,
             model=args.model)
+        def on_ship(req, art_dir, ordinal, seq, start, end, length):
+            # Late-bound over `journal`/`gens` (created right below, before
+            # the scheduler can run a prefill). Chaos first (ship_corrupt,
+            # keyed by export ordinal) so the journal record always names
+            # the artifact in its final — possibly poisoned — state.
+            if chaos is not None:
+                chaos.on_ship(art_dir, ordinal)
+            journal.ship(req.id, args.host_id, art_dir, seq, start, end,
+                         length, gens.get(req.id, 0),
+                         trace_id=req.trace_id)
+
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id),
                           stop_check=lambda: flag.signum is not None,
                           spill_dir=args.spill_dir or None,
                           on_spill=(chaos.on_spill if chaos is not None
-                                    else None))
+                                    else None),
+                          role=args.role,
+                          ship_dir=(os.path.join(args.journal_dir,
+                                                 f"ships_{args.host_id}")
+                                    if args.role == "prefill" else None),
+                          on_ship=(on_ship if args.role == "prefill"
+                                   else None),
+                          on_prefill_chunk=(chaos.on_prefill_chunk
+                                            if chaos is not None
+                                            else None))
+    _M_ENGINE_ROLE.labels(engine_role=args.role).set(1)
 
     store = FileKVStore(args.store)
     lease = LeaseRegistry(store, host_id=args.host_id,
@@ -281,7 +341,8 @@ def main(argv=None) -> None:
         return slots_free, blocks_free, getattr(engine, "block_size", 1)
 
     slots_free, blocks_free, block_size = capacity()
-    lease.register(slots_free, blocks_free, block_size)
+    lease.register(slots_free, blocks_free, block_size,
+                   role=args.role, kv_dtype=engine.kv_dtype)
     events.emit_audit(
         logger, AUDIT_FLEET_JOIN_FMT.format(
             host=args.host_id, slots=slots_free, blocks=blocks_free,
@@ -301,6 +362,26 @@ def main(argv=None) -> None:
         nonlocal n_done
         for c in sched.completed[n_done:]:
             gen = gens.get(c.request_id, 0)
+            if c.reason == "prefill":
+                # dedicated-prefill completion: the committed stream is
+                # ONE token (the first), the KV already shipped — journal
+                # prefill_done so the router can place the decode half.
+                # No decoded-output print: the request is not finished,
+                # the decode host owns the final stream.
+                journal.prefill_done(c.request_id, args.host_id, c.tokens,
+                                     gen, kv_dtype=engine.kv_dtype,
+                                     trace_id=c.trace_id)
+                done_ids.add(c.request_id)
+                events.emit_audit(
+                    logger, AUDIT_REQUEST_DONE_FMT.format(
+                        id=c.request_id, reason=c.reason,
+                        prompt_tokens=c.prompt_len,
+                        new_tokens=len(c.tokens),
+                        ttft_ms=c.ttft_seconds * 1e3,
+                        tps=c.decode_tokens_per_sec),
+                    "request_done", id=c.request_id, reason=c.reason,
+                    tokens=len(c.tokens), gen=gen, host=args.host_id)
+                continue
             journal.done(c.request_id, args.host_id, c.tokens, c.reason,
                          gen=gen, trace_id=c.trace_id)
             done_ids.add(c.request_id)
@@ -324,7 +405,8 @@ def main(argv=None) -> None:
         if chaos is not None:
             chaos.on_heartbeat(it)  # heartbeat_delay: a slow-but-alive host
         slots_free, blocks_free, block_size = capacity()
-        renewed = lease.renew(slots_free, blocks_free, block_size)
+        renewed = lease.renew(slots_free, blocks_free, block_size,
+                              role=args.role, kv_dtype=engine.kv_dtype)
         if not renewed or lease.fenced():
             # self-fence: this host can no longer prove its lease live —
             # a migrated replica may already be running, so NO further
@@ -361,7 +443,13 @@ def main(argv=None) -> None:
                     # admission imports the blocks; any failure falls back
                     # to the committed-prefix replay
                     handoff_artifact=str(rec.get("handoff", "") or ""),
-                    handoff_gen=gen)
+                    handoff_gen=gen,
+                    # disaggregated intake: a 'decode' record carries the
+                    # prefill host's verified shipment list; admission
+                    # imports them (prefix-cache-deduped), or replays the
+                    # committed prefix when the list is empty/rejected
+                    shipments=rec.get("shipments") or None,
+                    ship_gen=gen)
             except ValueError as e:
                 logger.warning(f"[FLEET] rejecting assignment {rid}: {e}")
                 continue

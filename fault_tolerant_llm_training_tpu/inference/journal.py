@@ -29,6 +29,22 @@ Record kinds:
   re-admission can ship blocks instead of replaying the prefix — a
   missing/torn/CRC-rejected artifact degrades to the replay with nothing
   lost.
+- ``ship``     a prefill-role host exported one contiguous run of a
+  request's committed prompt blocks as a checksummed artifact — the
+  incremental block shipments of disaggregated prefill/decode. Advisory
+  like ``handoff``: shipments of the newest generation are collected per
+  request; a stale/poisoned shipment degrades the decode admission to
+  committed-prefix replay.
+- ``prefill_done`` a prefill-role host finished a request's prefill: the
+  committed baseline is the sampled first token(s), the shipments cover
+  the whole effective prompt, and the request now needs DECODE placement.
+  Ownership stays with the prefill host (same gen) until the router
+  writes the ``decode`` record.
+- ``decode``   router -> decode host: ownership transfer at gen+1 after
+  ``prefill_done``, self-contained like ``migrate`` (params + committed
+  baseline) plus the router-verified shipment list the destination may
+  import instead of re-running prefill. An empty shipment list IS the
+  replay fallback.
 
 :func:`fold` reduces all files to per-request state. Resolution leans on
 the fleet's determinism contract: committed lists written for the same
@@ -65,6 +81,11 @@ class RequestState:
     trace_id: str = ""             # obs/reqtrace.py span-trail key
     handoff_artifact: str = ""     # newest exported block-artifact dir
     handoff_gen: int = -1          # generation that exported it
+    prefill_done: bool = False     # a prefill-role host finished prefill
+    prefill_gen: int = -1          # generation that finished it
+    kv_dtype: str = ""             # pool dtype the shipments were cut in
+    shipments: List[Dict] = field(default_factory=list)
+    ship_gen: int = -1             # generation the shipments belong to
 
 
 class RequestJournal:
@@ -143,6 +164,55 @@ class RequestJournal:
                       "committed": [int(t) for t in committed],
                       "gen": int(gen), "trace_id": str(trace_id)})
 
+    def ship(self, request_id: str, host: str, artifact: str, seq: int,
+             start_block: int, end_block: int, length: int, gen: int,
+             trace_id: str = "") -> None:
+        """One incremental block shipment: ``artifact`` holds this
+        request's prompt blocks ``[start_block, end_block)``, exported at
+        a prefill chunk commit with ``length`` tokens committed in the
+        slot. Written AFTER the artifact manifest commits (same fsync
+        ordering as ``handoff``), so a record always points at a complete
+        artifact."""
+        self._append({"kind": "ship", "id": request_id, "host": host,
+                      "artifact": str(artifact), "seq": int(seq),
+                      "start_block": int(start_block),
+                      "end_block": int(end_block), "length": int(length),
+                      "gen": int(gen), "trace_id": str(trace_id)})
+
+    def prefill_done(self, request_id: str, host: str, committed: List[int],
+                     gen: int, kv_dtype: str = "bf16",
+                     trace_id: str = "") -> None:
+        self._append({"kind": "prefill_done", "id": request_id,
+                      "host": host,
+                      "committed": [int(t) for t in committed],
+                      "kv_dtype": str(kv_dtype), "gen": int(gen),
+                      "trace_id": str(trace_id)})
+
+    def decode(self, request_id: str, src: str, dst: str, gen: int,
+               prompt: List[int], max_new_tokens: int, temperature: float,
+               top_p: float, seed: int, committed: List[int],
+               shipments: Optional[List[Dict]] = None,
+               trace_id: str = "") -> None:
+        """Ownership transfer prefill host -> decode host. ``shipments``
+        is the router-VERIFIED subset of the prefill host's ship records
+        (artifact + block range each); empty/None means the decode host
+        replays the committed prefix instead of importing."""
+        self._append({"kind": "decode", "id": request_id, "src": src,
+                      "host": dst, "gen": int(gen),
+                      "prompt": [int(t) for t in prompt],
+                      "max_new_tokens": int(max_new_tokens),
+                      "temperature": float(temperature),
+                      "top_p": float(top_p), "seed": int(seed),
+                      "committed": [int(t) for t in committed],
+                      "shipments": [
+                          {"artifact": str(s["artifact"]),
+                           "seq": int(s["seq"]),
+                           "start_block": int(s["start_block"]),
+                           "end_block": int(s["end_block"]),
+                           "length": int(s["length"])}
+                          for s in (shipments or [])],
+                      "trace_id": str(trace_id)})
+
     def requeue(self, request_id: str, prompt: List[int],
                 max_new_tokens: int, temperature: float, top_p: float,
                 seed: int, committed: List[int], gen: int,
@@ -216,7 +286,7 @@ def fold(root: str) -> Dict[str, RequestState]:
     """Reduce every journal file under ``root`` to per-request state.
 
     Ownership (host/gen) comes from the highest-generation
-    assign/migrate/requeue record; the committed list is the longest seen
+    assign/migrate/requeue/decode record; the committed list is the longest seen
     anywhere (all are prefixes of the same deterministic stream — verified,
     a mismatch raises); a ``done`` record wins outright, highest gen
     preferred when a fenced host double-reported."""
@@ -232,7 +302,7 @@ def fold(root: str) -> Dict[str, RequestState]:
         gen = int(rec.get("gen", 0))
         if rec.get("trace_id"):
             st.trace_id = str(rec["trace_id"])
-        if kind in ("assign", "migrate", "requeue"):
+        if kind in ("assign", "migrate", "requeue", "decode"):
             if gen >= st.gen:
                 st.gen = gen
                 st.host = rec.get("host")
@@ -249,6 +319,24 @@ def fold(root: str) -> Dict[str, RequestState]:
             # advisory block-shipment pointer; never touches ownership
             st.handoff_gen = gen
             st.handoff_artifact = str(rec.get("artifact", ""))
+        if kind == "ship":
+            # advisory like handoff; only the NEWEST generation's
+            # shipments survive (a re-prefill after death/drain re-ships
+            # at its own gen and the stale set must not mix in)
+            if gen > st.ship_gen:
+                st.ship_gen = gen
+                st.shipments = []
+            if gen == st.ship_gen:
+                st.shipments.append({
+                    "artifact": str(rec.get("artifact", "")),
+                    "seq": int(rec.get("seq", 0)),
+                    "start_block": int(rec.get("start_block", 0)),
+                    "end_block": int(rec.get("end_block", 0)),
+                    "length": int(rec.get("length", 0))})
+        if kind == "prefill_done" and gen >= st.prefill_gen:
+            st.prefill_done = True
+            st.prefill_gen = gen
+            st.kv_dtype = str(rec.get("kv_dtype", "") or "")
         committed = rec.get("committed") if kind != "done" else rec.get("tokens")
         if committed is not None:
             committed = [int(t) for t in committed]

@@ -565,51 +565,68 @@ def verify_block_artifact(art_dir: str) -> Dict:
     return manifest
 
 
-def import_blocks(cache: PagedKVCache, art_dir: str,
-                  dest_blocks: Sequence[int]
-                  ) -> Tuple[PagedKVCache, Dict]:
-    """Verify artifact ``art_dir`` and scatter its payloads into pool rows
-    ``dest_blocks`` (payload i -> ``dest_blocks[i]``). ALL verification —
-    CRC of every payload AND geometry vs the live pool — happens before the
-    first device write; on any mismatch :class:`KVBlockIntegrityError` is
-    raised and ``cache`` is returned unmodified by the caller's contract.
-    ``lengths`` is NOT touched here (the destination slot differs between
-    spill-restore and handoff-import); callers set it from the manifest's
-    ``length``. Returns ``(new_cache, manifest)``."""
-    manifest = verify_block_artifact(art_dir)
-    geo = manifest["geometry"]
+def import_block_batch(cache: PagedKVCache,
+                       parts: Sequence[Tuple[str, Sequence[int]]]
+                       ) -> Tuple[PagedKVCache, List[Dict]]:
+    """Verify EVERY artifact in ``parts`` (``(art_dir, dest_blocks)``
+    pairs, payload i of each artifact -> its ``dest_blocks[i]``) and land
+    them all with ONE gather-scatter per pool array — a request's
+    multi-chunk shipment train costs a single pool copy instead of one
+    per artifact, which is what keeps a decode engine's admission stall
+    off its decode-round tail. ALL verification — CRC of every payload,
+    geometry vs the live pool, destination-row counts — happens before
+    the first device write; on any mismatch
+    :class:`KVBlockIntegrityError` is raised and ``cache`` is returned
+    unmodified by the caller's contract. ``lengths`` is NOT touched here
+    (the destination slot differs between spill-restore, handoff-import
+    and shipment-import); callers set it from the manifests' ``length``.
+    Returns ``(new_cache, manifests)`` in ``parts`` order."""
     live = _cache_geometry(cache)
-    if geo != live:
-        raise KVBlockIntegrityError(
-            f"block artifact geometry {geo} does not fit pool {live}")
-    n = len(manifest["blocks"])
-    if len(dest_blocks) != n:
-        raise ValueError(
-            f"artifact has {n} block(s) but {len(dest_blocks)} destination "
-            f"row(s) given")
-    if 0 in dest_blocks:
-        raise ValueError("refusing to import into reserved null block 0")
+    manifests: List[Dict] = []
+    dests: List[int] = []
+    for art_dir, dest_blocks in parts:
+        manifest = verify_block_artifact(art_dir)
+        geo = manifest["geometry"]
+        if geo != live:
+            raise KVBlockIntegrityError(
+                f"block artifact geometry {geo} does not fit pool {live}")
+        n = len(manifest["blocks"])
+        if len(dest_blocks) != n:
+            raise ValueError(
+                f"artifact has {n} block(s) but {len(dest_blocks)} "
+                f"destination row(s) given")
+        if 0 in dest_blocks:
+            raise ValueError("refusing to import into reserved null "
+                             "block 0")
+        manifests.append(manifest)
+        dests.extend(int(b) for b in dest_blocks)
     n_layers = len(cache.k)
     layout = block_layout(cache)
     total = sum(int(seg["nbytes"]) for seg in layout)
     hosts = {(seg["layer"], seg["field"]):
-             np.empty((n,) + seg["shape"], seg["dtype"]) for seg in layout}
-    for j in range(n):
-        with open(os.path.join(art_dir, _block_file_name(j)), "rb") as f:
-            payload = f.read()
-        if len(payload) != total:
-            raise KVBlockIntegrityError(
-                f"block payload {j} has {len(payload)} byte(s), geometry "
-                f"needs {total}")
-        for seg in layout:
-            off = int(seg["offset"])
-            hosts[(seg["layer"], seg["field"])][j] = np.frombuffer(
-                payload[off:off + int(seg["nbytes"])],
-                seg["dtype"]).reshape(seg["shape"])
-    idx = jnp.asarray(np.asarray(list(dest_blocks), np.int32))
+             np.empty((len(dests),) + seg["shape"], seg["dtype"])
+             for seg in layout}
+    row = 0
+    for (art_dir, _), manifest in zip(parts, manifests):
+        for j in range(len(manifest["blocks"])):
+            with open(os.path.join(art_dir, _block_file_name(j)),
+                      "rb") as f:
+                payload = f.read()
+            if len(payload) != total:
+                raise KVBlockIntegrityError(
+                    f"block payload {j} has {len(payload)} byte(s), "
+                    f"geometry needs {total}")
+            for seg in layout:
+                off = int(seg["offset"])
+                hosts[(seg["layer"], seg["field"])][row] = np.frombuffer(
+                    payload[off:off + int(seg["nbytes"])],
+                    seg["dtype"]).reshape(seg["shape"])
+            row += 1
+    idx = jnp.asarray(np.asarray(dests, np.int32))
 
-    # Import is rare (restore/handoff, not per token), so plain .at[].set
-    # per pool array is fine — no AOT program, no donation games.
+    # Import is rare (restore/handoff/shipment admission, not per token),
+    # so plain .at[].set per pool array is fine — no AOT program, no
+    # donation games; the batching above keeps it to one set per array.
     def rebuild(pool, layer, field):
         if isinstance(pool, QuantPool):
             return QuantPool(
@@ -623,7 +640,18 @@ def import_blocks(cache: PagedKVCache, art_dir: str,
                   for layer in range(n_layers))
     new_v = tuple(rebuild(cache.v[layer], layer, "v")
                   for layer in range(n_layers))
-    return cache.replace(k=new_k, v=new_v), manifest
+    return cache.replace(k=new_k, v=new_v), manifests
+
+
+def import_blocks(cache: PagedKVCache, art_dir: str,
+                  dest_blocks: Sequence[int]
+                  ) -> Tuple[PagedKVCache, Dict]:
+    """Single-artifact :func:`import_block_batch` — same
+    verify-everything-before-any-device-write contract; returns
+    ``(new_cache, manifest)``."""
+    new_cache, manifests = import_block_batch(
+        cache, [(art_dir, dest_blocks)])
+    return new_cache, manifests[0]
 
 
 def artifact_bytes(manifest: Dict) -> int:

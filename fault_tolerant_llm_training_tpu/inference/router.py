@@ -23,11 +23,24 @@ Responsibilities, once per loop:
    names it in the ``migrate`` record so the survivor imports blocks
    instead of replaying — a torn or corrupt artifact is rejected here
    and the migration silently degrades to committed-prefix replay;
-4. assign queued requests to the live host with the most estimated free
+4. advance disaggregated requests whose prefill-role host journaled
+   ``prefill_done``: CRC-verify every incremental ``ship`` artifact of
+   the newest generation, pick a decode-capable host whose lease
+   advertises the SAME kv-dtype, and write a ``decode`` record at gen+1
+   naming the verified shipment list — ownership transfer prefill ->
+   decode. ANY rejected shipment drops the whole list (the decode
+   admission replays the committed prefix bit-exactly instead), and a
+   missing dtype-matching decode host degrades to the same replay on any
+   decode-capable host;
+5. assign queued requests to the live host with the most estimated free
    KV blocks (lease capacity metadata, decremented locally per
    assignment so a burst between heartbeats doesn't dogpile one host —
    over-assignment is safe anyway: the scheduler queues on block
-   exhaustion).
+   exhaustion). Placement is ROLE-aware: fresh intake lands on
+   prefill-capable hosts, committed history on decode-capable ones, and
+   a dedicated prefill host is refused AT PLACEMENT TIME (before any
+   prefill runs) when no decode-capable peer of its kv-dtype exists —
+   the mixed-dtype pair can never produce an importable shipment.
 
 Exactly-once: the router is the ONLY writer of assign/migrate records,
 a dead host is swept once (tombstone + ``handled`` latch), and fold
@@ -37,7 +50,9 @@ nothing.
 
 /metrics (when --metrics-port is set): ``fleet_hosts_live``,
 ``requests_migrated_total``, ``fleet_lease_age_seconds{host=...}``,
-``handoff_crc_rejected_total``.
+``handoff_crc_rejected_total``, ``ship_crc_rejected_total``,
+``disagg_decode_placements_total``,
+``disagg_placements_rejected_total``.
 """
 
 import argparse
@@ -55,6 +70,8 @@ from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
 from ..obs.registry import REGISTRY
 from ..utils.logging import (
+    AUDIT_DISAGG_PLACE_FMT,
+    AUDIT_DISAGG_SHIP_FMT,
     AUDIT_FLEET_DEAD_FMT,
     AUDIT_FLEET_MIGRATE_FMT,
     AUDIT_HANDOFF_FMT,
@@ -77,6 +94,19 @@ _M_HANDOFF_REJECTED = REGISTRY.counter(
     "handoff_crc_rejected_total",
     "Handoff artifacts rejected by CRC/size/geometry verification "
     "(the request falls back to committed-prefix replay)")
+_M_SHIP_REJECTED = REGISTRY.counter(
+    "ship_crc_rejected_total",
+    "Incremental block shipments rejected by CRC/size verification; one "
+    "bad shipment drops the request's whole list and the decode "
+    "admission replays the committed prefix")
+_M_DECODE_PLACED = REGISTRY.counter(
+    "disagg_decode_placements_total",
+    "Ownership transfers prefill host -> decode host ('decode' journal "
+    "records written after prefill_done)")
+_M_PLACE_REJECTED = REGISTRY.counter(
+    "disagg_placements_rejected_total",
+    "Dedicated-prefill placements refused at placement time because no "
+    "decode-capable peer of the same kv-dtype held a live lease")
 
 
 class Router:
@@ -96,6 +126,11 @@ class Router:
         self.assigned: Dict[str, tuple] = {}  # rid -> (host, gen) I wrote
         self.handled_dead = set()
         self.migrated_total = 0
+        self.decode_placed_total = 0
+        # (request_id, host) pairs whose mixed-dtype placement rejection
+        # was already audited — the once-latch keeps the per-loop
+        # pick_host retry from spamming the log
+        self._place_rejected = set()
         # per-host capacity estimate, reset whenever the host stamps a
         # fresh lease, decremented locally per assignment in between
         self.est: Dict[str, dict] = {}
@@ -132,7 +167,10 @@ class Router:
             if e is None or e["stamp"] != l.t:
                 self.est[h] = {"stamp": l.t, "slots": l.slots_free,
                                "blocks": l.blocks_free,
-                               "block_size": max(1, l.block_size)}
+                               "block_size": max(1, l.block_size),
+                               "role": getattr(l, "role", "both") or "both",
+                               "kv_dtype": (getattr(l, "kv_dtype", "bf16")
+                                            or "bf16")}
         for h in list(self.est):
             if h not in live:
                 del self.est[h]
@@ -148,14 +186,66 @@ class Router:
     def pick_host(self, item: dict) -> Optional[str]:
         """Admission policy: the live host with the most estimated free
         blocks, hosts with a free slot preferred. Returns None when no
-        live host exists (the request waits in ``pending``)."""
+        eligible host exists (the request waits in ``pending``).
+
+        Role-aware: fresh intake (no committed history) needs a
+        prefill-capable host, anything carrying committed tokens needs a
+        decode-capable one (the replay that continues the stream IS a
+        decode). A dedicated prefill host is refused at placement time —
+        before its prefill ever runs — unless a decode-capable peer of
+        the same kv-dtype holds a live lease, because a mixed-dtype pair
+        can never produce an importable shipment."""
+        stage = "decode" if item.get("committed") else "prefill"
         best = None
         for h in sorted(self.est):
             e = self.est[h]
+            role = e.get("role", "both")
+            if stage == "prefill" and role == "decode":
+                continue
+            if stage == "decode" and role == "prefill":
+                continue
+            if stage == "prefill" and role == "prefill":
+                dtype = e.get("kv_dtype", "bf16")
+                if self._pick_decode_host(dtype) is None:
+                    self._reject_place(item, h, dtype)
+                    continue
             key = (e["slots"] > 0, e["blocks"])
             if best is None or key > best[0]:
                 best = (key, h)
         return best[1] if best else None
+
+    def _pick_decode_host(self, kv_dtype: Optional[str] = None
+                          ) -> Optional[str]:
+        """The decode-capable live host with the most estimated free
+        blocks, optionally pinned to a kv-dtype (shipment imports need
+        the pool dtypes to match; the replay fallback does not)."""
+        best = None
+        for h in sorted(self.est):
+            e = self.est[h]
+            if e.get("role", "both") not in ("both", "decode"):
+                continue
+            if (kv_dtype is not None
+                    and e.get("kv_dtype", "bf16") != kv_dtype):
+                continue
+            key = (e["slots"] > 0, e["blocks"])
+            if best is None or key > best[0]:
+                best = (key, h)
+        return best[1] if best else None
+
+    def _reject_place(self, item: dict, host: str, dtype: str) -> None:
+        key = (item["id"], host)
+        if key in self._place_rejected:
+            return
+        self._place_rejected.add(key)
+        _M_PLACE_REJECTED.inc()
+        events.emit_audit(
+            logger, AUDIT_DISAGG_PLACE_FMT.format(
+                action="reject", id=item["id"], gen=item["gen"],
+                detail=f"prefill host {host} pools kv_dtype {dtype} but "
+                       f"no {dtype} decode-capable peer is live — "
+                       f"mixed-dtype pair refused before prefill"),
+            "disagg_place", id=item["id"], gen=item["gen"],
+            action="reject", host=host, kv_dtype=dtype)
 
     def _charge(self, host: str, item: dict) -> None:
         e = self.est.get(host)
@@ -263,6 +353,120 @@ class Router:
                               replayed=len(item["committed"]))
         self._charge(dst, item)
 
+    # ------------------------------------------- disaggregated decode handoff
+    def _verify_shipments(self, st: RequestState) -> list:
+        """CRC-verify every incremental shipment of the newest
+        generation, in seq order. ALL-OR-NOTHING: one rejected artifact
+        drops the whole list (returns []), because the decode admission
+        needs contiguous coverage of the effective prompt — a hole means
+        replaying anyway, and mixing verified blocks with a replay buys
+        nothing. Same retry/terminal split as :meth:`_verify_handoff`."""
+        if st.ship_gen != st.prefill_gen or not st.shipments:
+            return []
+        ships = sorted(st.shipments, key=lambda s: int(s.get("seq", 0)))
+        for s in ships:
+            art = str(s.get("artifact", "") or "")
+
+            def _verify_once(art=art):
+                try:
+                    return verify_block_artifact(art)
+                except KVBlockIntegrityError as e:
+                    if isinstance(e.__cause__, OSError):
+                        raise e.__cause__
+                    raise
+
+            try:
+                retry_with_backoff(
+                    _verify_once, deadline_seconds=1.0,
+                    retry_on=(OSError,), clock=time.monotonic,
+                    sleep=time.sleep,
+                    what=f"shipment artifact read {art}")
+            except (KVBlockIntegrityError, RetryDeadlineExceeded) as e:
+                _M_SHIP_REJECTED.inc()
+                events.emit_audit(
+                    logger, AUDIT_DISAGG_SHIP_FMT.format(
+                        action="reject", id=st.request_id,
+                        seq=int(s.get("seq", 0)), gen=st.gen + 1,
+                        start=int(s.get("start_block", 0)),
+                        end=int(s.get("end_block", 0)), detail=str(e)),
+                    "disagg_ship", id=st.request_id,
+                    seq=int(s.get("seq", 0)), gen=st.gen + 1,
+                    action="reject", artifact=art, detail=str(e))
+                return []
+        return ships
+
+    def advance_prefilled(self) -> int:
+        """Place the decode half of every request whose prefill-role host
+        journaled ``prefill_done``: verify the shipments, pick a
+        dtype-matching decode-capable host, and write the ``decode``
+        record at gen+1 (ownership transfer — the prefill host is done
+        with it whether it lives or dies). Returns placements written.
+
+        Degradations, in order: a rejected shipment ships nothing (the
+        decode host replays the committed prefix bit-exactly); verified
+        shipments with no dtype-matching decode host also ship nothing
+        (any decode-capable host can replay); no decode-capable host at
+        all leaves the request waiting for the next sweep to find one."""
+        n = 0
+        for st in fold(self.journal_dir).values():
+            if st.done or not st.prefill_done or st.gen > st.prefill_gen:
+                continue
+            if st.request_id in self.pending_ids:
+                continue
+            a = self.assigned.get(st.request_id)
+            if a is not None and a[1] > st.gen:
+                continue
+            gen = st.gen + 1
+            if len(st.committed) >= st.max_new_tokens:
+                # max_new_tokens == 1: the sampled first token IS the
+                # whole stream — complete in place, no decode half
+                self.journal.done(st.request_id, "router", st.committed,
+                                  "length", gen=gen,
+                                  trace_id=st.trace_id)
+                self.assigned[st.request_id] = ("router", gen)
+                continue
+            dtype = st.kv_dtype or "bf16"
+            ships = self._verify_shipments(st)
+            dst = self._pick_decode_host(dtype if ships else None)
+            if dst is None and ships:
+                events.emit_audit(
+                    logger, AUDIT_DISAGG_PLACE_FMT.format(
+                        action="replay", id=st.request_id, gen=gen,
+                        detail=f"no {dtype} decode-capable host for "
+                               f"{len(ships)} verified shipment(s); "
+                               f"falling back to committed-prefix "
+                               f"replay"),
+                    "disagg_place", id=st.request_id, gen=gen,
+                    action="replay", kv_dtype=dtype)
+                ships = []
+                dst = self._pick_decode_host(None)
+            if dst is None:
+                continue  # no decode capacity yet — retry next loop
+            self.journal.decode(st.request_id, st.host or "", dst, gen,
+                                list(st.prompt), st.max_new_tokens,
+                                st.temperature, st.top_p, st.seed,
+                                list(st.committed), shipments=ships,
+                                trace_id=st.trace_id)
+            self.assigned[st.request_id] = (dst, gen)
+            self.decode_placed_total += 1
+            _M_DECODE_PLACED.inc()
+            events.emit_audit(
+                logger, AUDIT_DISAGG_PLACE_FMT.format(
+                    action="decode", id=st.request_id, gen=gen,
+                    detail=f"{st.host or '?'} -> {dst}, "
+                           f"{len(ships)} shipment(s), kv_dtype {dtype}"),
+                "disagg_place", id=st.request_id, gen=gen,
+                action="decode", src=st.host, dst=dst,
+                shipments=len(ships), kv_dtype=dtype)
+            if st.trace_id:
+                reqtrace.emit(st.trace_id, st.request_id,
+                              "decode_placement", src=st.host, dst=dst,
+                              gen=gen, shipments=len(ships))
+            self._charge(dst, {"prompt": st.prompt,
+                               "max_new_tokens": st.max_new_tokens})
+            n += 1
+        return n
+
     def sweep(self, now: Optional[float] = None) -> int:
         """Render dead verdicts and migrate the victims' in-flight
         requests. Returns how many requests were queued for migration."""
@@ -278,7 +482,12 @@ class Router:
             states = fold(self.journal_dir)
             inflight = sorted(
                 (st for st in states.values()
-                 if st.host == h and not st.done),
+                 if st.host == h and not st.done
+                 # a prefill-done request is NOT lost with its prefill
+                 # host: the shipments live on shared disk and
+                 # advance_prefilled() still owns the decode placement
+                 # (verified import, or replay if an artifact is bad)
+                 and not (st.prefill_done and st.gen <= st.prefill_gen)),
                 key=lambda st: st.request_id)
             events.emit_audit(
                 logger, AUDIT_FLEET_DEAD_FMT.format(
@@ -459,6 +668,7 @@ def main(argv=None) -> int:
     while True:
         follower.ingest(router)
         router.sweep()
+        router.advance_prefilled()
         router.adopt_requeued()
         router.assign_pending()
         done, total, all_done = router.status(args.expected)

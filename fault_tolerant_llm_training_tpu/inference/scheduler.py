@@ -87,6 +87,17 @@ serving analogue of the trainer's save-on-signal exit policy. Chunked
 prefills consult ``stop_check`` between chunks, so a signal that lands
 mid-prompt finishes the current chunk only, frees the request's blocks and
 reports it unserved — the drain stays exact even for long prompts.
+
+Disaggregated roles (DistServe/Splitwise): ``role="prefill"`` keeps both
+prefill lanes but exports every committed chunk's full blocks as an
+incremental checksummed shipment (kv_cache.export_blocks) and finishes the
+request with reason ``"prefill"`` — its decode belongs to a decode-role
+peer. ``role="decode"`` admits such requests by importing the shipments
+(CRC + journal agreement verified BEFORE any device write, prefix-cache
+deduped) and resumes decode bit-exactly at the committed offset; any
+verification failure degrades to the committed-prefix replay, which the
+decode engine can always run because its prefill path is intact — that IS
+the fallback ladder. ``role="both"`` (default) is the colocated engine.
 """
 
 import dataclasses
@@ -107,6 +118,7 @@ from ..obs.registry import (
     default_registry,
 )
 from ..utils.logging import (
+    AUDIT_DISAGG_SHIP_FMT,
     AUDIT_HANDOFF_FMT,
     AUDIT_KV_LEAK_FMT,
     AUDIT_KV_TIER_FMT,
@@ -116,6 +128,8 @@ from .kv_cache import (
     KVBlockIntegrityError,
     artifact_bytes,
     block_bytes,
+    export_blocks,
+    verify_block_artifact,
 )
 from .prefix_cache import PrefixCache
 
@@ -342,7 +356,11 @@ class Scheduler:
                  prefill_batch: int = 1, adaptive_burst: bool = False,
                  enable_spill: bool = False,
                  spill_dir: Optional[str] = None,
-                 on_spill: Optional[Callable[[str, int], None]] = None):
+                 on_spill: Optional[Callable[[str, int], None]] = None,
+                 role: str = "both",
+                 ship_dir: Optional[str] = None,
+                 on_ship: Optional[Callable] = None,
+                 on_prefill_chunk: Optional[Callable[[int], None]] = None):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -387,6 +405,43 @@ class Scheduler:
         self._handoff_artifacts: Dict[str, str] = {}
         self.handoff_imports = 0
         self.handoff_rejects = 0
+        # Disaggregated prefill/decode (DistServe/Splitwise split over the
+        # checksummed artifact path). role="prefill": admissions run the
+        # ordinary prefill lanes but every committed chunk is EXPORTED as
+        # an incremental block shipment (``on_ship`` fires per artifact —
+        # fleet.py journals it) and the request finishes with reason
+        # "prefill" instead of entering decode. role="decode": submit()
+        # accepts the journaled shipment list and admission IMPORTS the
+        # shipped blocks — prefix-cache-deduped — instead of replay-
+        # prefilling; any verification failure degrades to the bit-exact
+        # committed-prefix replay (the full prefill path stays available,
+        # which IS the fallback ladder). role="both" is the colocated
+        # engine, unchanged.
+        self.role = str(role)
+        if self.role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r} "
+                             f"(want both|prefill|decode)")
+        if self.role != "both" and self.kv_layout != "paged":
+            raise ValueError("prefill/decode roles require the paged KV "
+                             "layout (shipments are block artifacts)")
+        if self.role != "both" and int(getattr(engine, "spec_k", 0) or 0):
+            raise ValueError("prefill/decode roles do not support "
+                             "speculative decoding (the draft pool's "
+                             "blocks are not shipped)")
+        self._ship_dir_arg = ship_dir
+        self._ship_root_path: Optional[str] = None
+        self._on_ship = on_ship
+        self._on_prefill_chunk = on_prefill_chunk
+        # request id -> {"shipped": blocks exported, "seq": next artifact}
+        self._ship_state: Dict[str, dict] = {}
+        self._ship_req_gen: Dict[str, int] = {}  # assignment generation
+        # request id -> (journaled shipment records, generation) — the
+        # decode-side admission input (fleet.py feeds it from the journal's
+        # "decode" record)
+        self._shipments: Dict[str, tuple] = {}
+        self.ship_exports = 0                  # artifact ordinal (chaos key)
+        self.ship_imports = 0
+        self.ship_rejects = 0
         if self.enable_spill and self.kv_layout != "paged":
             raise ValueError("the spill tier requires the paged KV layout")
         if self.enable_spill and int(getattr(engine, "spec_k", 0) or 0):
@@ -614,6 +669,19 @@ class Scheduler:
             "handoff_crc_rejected_total",
             "Handoff artifacts rejected by CRC/size/geometry verification "
             "(the request falls back to committed-prefix replay)")
+        self._m_ship_exports = r.counter(
+            "disagg_shipments_exported_total",
+            "Incremental KV block shipments exported by a prefill-role "
+            "engine (one checksummed artifact per committed chunk group)")
+        self._m_ship_imports = r.counter(
+            "disagg_shipments_imported_total",
+            "Block shipments CRC-verified and imported by a decode-role "
+            "engine (prefix-cache-deduped shipments count as imported)")
+        self._m_ship_rejected = r.counter(
+            "disagg_shipments_rejected_total",
+            "Shipment admissions rejected by CRC/metadata/coverage "
+            "verification (the request falls back to committed-prefix "
+            "replay on the decode engine)")
         # Content-addressed prefix reuse: only engines that OPT IN get the
         # cache (InferenceEngine sets enable_prefix_cache in paged mode;
         # test doubles without the attribute keep plain allocation).
@@ -677,14 +745,29 @@ class Scheduler:
 
     def submit(self, request: Request,
                handoff_artifact: Optional[str] = None,
-               handoff_gen: int = 0) -> None:
+               handoff_gen: int = 0,
+               shipments: Optional[Sequence[dict]] = None,
+               ship_gen: int = 0) -> None:
         committed = list(getattr(request, "committed", ()) or ())
+        if shipments and self.role == "prefill":
+            raise ValueError(
+                f"request {request.id}: a prefill-role engine cannot "
+                f"accept block shipments (it only exports them)")
+        if self.role == "prefill":
+            # generation the shipments will be journaled under (audit)
+            self._ship_req_gen[request.id] = int(ship_gen)
         if handoff_artifact and committed:
             # Block-shipment admission: _admit imports the artifact's
             # committed blocks instead of replay-prefilling; any
             # verification failure falls back to the replay path below.
             self._handoff_artifacts[request.id] = (handoff_artifact,
                                                    int(handoff_gen))
+        if shipments and committed:
+            # Disaggregated admission: _admit imports the prefill engine's
+            # incremental shipments instead of replay-prefilling; any
+            # verification failure falls back to the replay path below.
+            self._shipments[request.id] = (
+                [dict(s) for s in shipments], int(ship_gen))
         if committed and len(committed) >= request.max_new_tokens:
             raise ValueError(
                 f"request {request.id}: {len(committed)} committed tokens "
@@ -742,6 +825,7 @@ class Scheduler:
 
     def _finish(self, slot: int, reason: str, done: List[Completion]) -> None:
         st = self.active.pop(slot)
+        self._ship_state.pop(st.request.id, None)
         if self.adaptive_k is not None:
             self.adaptive_k.forget(st.request.id)
         if self.kv_layout == "paged":
@@ -795,6 +879,11 @@ class Scheduler:
         else:
             self.prefill_gather_chunks += 1
             self._m_prefill_gather.inc()
+        if self._on_prefill_chunk is not None:
+            # chaos hook (prefill_kill): fires BEFORE the chunk's shipment
+            # exports, so a kill at ordinal N lands with chunk N computed
+            # but unshipped — the mid-chunk death the disagg scenario needs
+            self._on_prefill_chunk(self.prefill_chunks - 1)
 
     def _drain_requested(self) -> bool:
         return self.stop_check is not None and bool(self.stop_check())
@@ -828,6 +917,20 @@ class Scheduler:
                 if outcome == "imported":
                     continue
                 # "fallback": artifact rejected — the replay path below
+                # re-derives the stream bit-exactly from prompt+committed
+            ship_entry = self._shipments.get(req.id)
+            if (ship_entry is not None and self.kv_layout == "paged"
+                    and not self.spec_k):
+                # Disaggregated admission: import the prefill engine's
+                # incremental shipments (prefix-cache-deduped) instead of
+                # replay-prefilling the committed prefix.
+                outcome = self._admit_from_shipments(req, submitted_at,
+                                                     free, ship_entry, done)
+                if outcome == "wait":
+                    break
+                if outcome == "imported":
+                    continue
+                # "fallback": shipment rejected — the replay path below
                 # re-derives the stream bit-exactly from prompt+committed
             # replay admissions prefill prompt + committed[:-1]; every
             # prefix-cache and prefill path below works on this view
@@ -927,6 +1030,17 @@ class Scheduler:
                 row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
                 row[:len(slot_blocks)] = slot_blocks
                 self.block_tables[slot] = row
+                if self.role == "prefill":
+                    # incremental-shipment ledger; a prefix-cache hit's
+                    # leading blocks are committed KV by definition, so
+                    # they ship IMMEDIATELY as artifact 0 — the decode
+                    # engine can be importing them while prefill still
+                    # streams the divergent remainder
+                    self._ship_state[req.id] = {
+                        "shipped": 0, "seq": 0,
+                        "gen": self._ship_req_gen.pop(req.id, 0)}
+                    if start_pos:
+                        self._ship_commit(req, slot_blocks, eff, start_pos)
                 if self.prefill_batch > 1:
                     # PACKED lane: ownership established (blocks, prefix
                     # references, full-hit COW all done above) — enqueue
@@ -964,12 +1078,28 @@ class Scheduler:
                     # only cache-aware engines accept the offset kwarg —
                     # test doubles without enable_prefix_cache never see it
                     spec_kw["start_pos"] = start_pos
+                on_chunk = self._count_chunk
+                if self.role == "prefill":
+                    # chunk-granular shipping: each finished chunk commits
+                    # its KV, so its full blocks export right here — the
+                    # incremental half of the disaggregated pipeline (the
+                    # packed lane does the same in _prefill_round)
+                    chunk_max = self.engine.prefill_buckets[-1]
+                    ship_pos = {"pos": start_pos}
+                    _req, _blocks, _eff = req, slot_blocks, eff
+
+                    def on_chunk():
+                        self._count_chunk()
+                        ship_pos["pos"] += min(chunk_max,
+                                               len(_eff) - ship_pos["pos"])
+                        self._ship_commit(_req, _blocks, _eff,
+                                          ship_pos["pos"])
                 t0 = self.clock()
                 first = self.engine.prefill(
                     slot, eff, block_row=row,
                     temperature=req.temperature, top_p=req.top_p,
                     seed=req.seed, stop_check=self._drain_requested,
-                    on_chunk=self._count_chunk, **spec_kw)
+                    on_chunk=on_chunk, **spec_kw)
                 pf_dur = self.clock() - t0
                 self.prefill_seconds += pf_dur
                 if first is None:
@@ -982,6 +1112,7 @@ class Scheduler:
                     # admission — the drain stays exact.
                     self.allocator.free(slot_blocks)
                     self.block_tables[slot] = 0
+                    self._ship_state.pop(req.id, None)
                     if self.spec_k:
                         self.draft_allocator.free(slot_dblocks)
                         self.draft_block_tables[slot] = 0
@@ -1016,6 +1147,15 @@ class Scheduler:
                         ttft=st.first_token_at - st.submitted_at)
             self.max_concurrent = max(self.max_concurrent, len(self.active))
             self._m_tokens.inc()  # the prefill's first token
+            if self.role == "prefill":
+                # prefill engine's contract: decode belongs to a decode
+                # engine. The final shipment exported with the last chunk;
+                # finish with the first token as the committed handoff
+                # point (fleet.py journals prefill_done, the router places
+                # the decode). EOS/budget on that token are the DECODE
+                # admission's finish checks — uniform either way.
+                self._finish(slot, "prefill", done)
+                continue
             # a request can finish straight out of prefill (a replay can
             # arrive with EOS as its last committed token, or within one
             # token of its budget — the same checks, on the banked tail)
@@ -1400,6 +1540,235 @@ class Scheduler:
                        detail)
         self._trace(req, "handoff_reject", detail=detail)
 
+    # --- disaggregated prefill/decode shipping ------------------------------
+
+    def _ship_root(self) -> str:
+        if self._ship_root_path is None:
+            if self._ship_dir_arg:
+                os.makedirs(self._ship_dir_arg, exist_ok=True)
+                self._ship_root_path = self._ship_dir_arg
+            else:
+                self._ship_root_path = tempfile.mkdtemp(prefix="kv_ship_")
+        return self._ship_root_path
+
+    def _audit_ship(self, action: str, rid: str, seq: int, gen: int,
+                    start: int, end: int, detail: str) -> None:
+        events.emit_audit(logger, AUDIT_DISAGG_SHIP_FMT.format(
+            action=action, id=rid, seq=seq, gen=gen, start=start, end=end,
+            detail=detail), "disagg_ship")
+
+    def _ship_commit(self, req: Request, slot_blocks: List[int],
+                     eff: Sequence[int], pos: int) -> None:
+        """Export the blocks the prefill just COMMITTED — full blocks up
+        to absolute position ``pos``, everything once ``pos`` reaches the
+        prompt end — as one incremental checksummed shipment. Chunk
+        boundaries rarely align with block boundaries, so a chunk whose
+        tokens all land inside a still-open block ships nothing; the next
+        boundary crossing carries it. The partially-filled final block
+        ships only with the LAST commit (its bytes keep changing until
+        then), which is what makes "decode never reads an uncommitted
+        block" structural: a shipment's blocks are immutable on export."""
+        st = self._ship_state.get(req.id)
+        if st is None:
+            return
+        bs = self.engine.block_size
+        end = -(-len(eff) // bs) if pos >= len(eff) else pos // bs
+        if end <= st["shipped"]:
+            return
+        start = st["shipped"]
+        seq = st["seq"]
+        length = int(min(pos, len(eff)))
+        art_dir = os.path.join(
+            self._ship_root(),
+            f"ship_{self.ship_exports:05d}_{req.id}_{seq:02d}")
+        t0 = self.clock()
+        manifest = export_blocks(
+            self.engine.cache, list(slot_blocks[start:end]), art_dir,
+            length=length,
+            meta={"kind": "ship", "request_id": req.id,
+                  "prompt": [int(t) for t in eff],
+                  "seq": seq, "start_block": start, "end_block": end})
+        dur = self.clock() - t0
+        nbytes = artifact_bytes(manifest)
+        ordinal = self.ship_exports
+        self.ship_exports += 1
+        st["shipped"] = end
+        st["seq"] = seq + 1
+        self._m_ship_exports.inc()
+        self._m_handoff_shipped.inc(end - start)
+        self._audit_ship("export", req.id, seq, st.get("gen", 0), start,
+                         end, os.path.basename(art_dir))
+        self._trace(req, "block_ship", dur=dur, seq=seq,
+                    blocks=end - start, bytes=nbytes, length=length)
+        if self._on_ship is not None:
+            # fleet.py: chaos (ship_corrupt, keyed by export ordinal)
+            # then the journal's ship record
+            self._on_ship(req, art_dir, ordinal, seq, start, end, length)
+
+    def _admit_from_shipments(self, req: Request, submitted_at: float,
+                              free: List[int], ship_entry,
+                              done: List[Completion]) -> str:
+        """Decode-side admission by incremental block import: CRC-verify
+        EVERY shipment and check contiguous coverage of the committed
+        prompt BEFORE touching the device (decode never reads an
+        uncommitted block), dedupe the leading shipments against the
+        prefix cache (already-resident shared-prompt blocks are acquired
+        by content, not re-imported), scatter the rest in, and resurrect
+        the slot at the exact decode step the prefill engine committed —
+        fold_in(seed, len(committed)) continues the SAME stream. Returns
+        'imported', 'wait' (pool shortage: head-of-line semantics
+        unchanged) or 'fallback' (rejected: the caller's replay path
+        re-derives the stream bit-exactly, the PR 13 degradation
+        contract)."""
+        ships, gen = ship_entry
+        committed = [int(t) for t in (req.committed or ())]
+        eff = [int(t) for t in self._effective_prompt(req)]
+        bs = self.engine.block_size
+        n_ship_blocks = -(-len(eff) // bs)
+        ships = sorted((dict(s) for s in ships),
+                       key=lambda s: int(s.get("seq", 0)))
+        if not committed or not ships:
+            self._ship_reject(req, gen, "no shipments for the committed "
+                                        "prefix")
+            return "fallback"
+        pos = 0
+        for s in ships:
+            if int(s.get("start_block", -1)) != pos:
+                pos = -1
+                break
+            pos = int(s.get("end_block", -1))
+        if (pos != n_ship_blocks
+                or int(ships[-1].get("length", -1)) != len(eff)):
+            self._ship_reject(req, gen, "shipments do not cover the "
+                                        "committed prompt contiguously")
+            return "fallback"
+        for s in ships:
+            art = str(s.get("artifact", ""))
+            try:
+                manifest = verify_block_artifact(art)
+            except (KVBlockIntegrityError, OSError) as e:
+                self._ship_reject(req, gen,
+                                  f"{os.path.basename(art)}: {e}")
+                return "fallback"
+            meta = manifest.get("meta", {})
+            s_start = int(s.get("start_block", -1))
+            s_end = int(s.get("end_block", -1))
+            if (meta.get("kind") != "ship"
+                    or str(meta.get("request_id")) != req.id
+                    or [int(t) for t in meta.get("prompt", [])] != eff
+                    or int(meta.get("seq", -1)) != int(s.get("seq", 0))
+                    or int(meta.get("start_block", -1)) != s_start
+                    or int(meta.get("end_block", -1)) != s_end
+                    or int(manifest.get("length", -1))
+                    != int(s.get("length", -1))
+                    or len(manifest.get("blocks", [])) != s_end - s_start):
+                self._ship_reject(
+                    req, gen, f"{os.path.basename(art)} disagrees with "
+                              f"the journal")
+                return "fallback"
+        # prefix-cache dedupe: shipments whose blocks are already resident
+        # (a shared prompt another decode admitted) are skipped, not
+        # re-imported — clamped DOWN to a shipment boundary because an
+        # artifact imports whole, and to FULL blocks only (the cache never
+        # holds the partial final block, which decode will write into)
+        n_full = len(eff) // bs
+        n_use, hit = 0, None
+        if self.prefix_cache is not None and n_full:
+            h = self.prefix_cache.match(eff)
+            covered = min(h.tokens // bs, n_full) if h.blocks else 0
+            if covered:
+                n_use = max([int(s["start_block"]) for s in ships
+                             if int(s["start_block"]) <= covered] + [0])
+            if n_use:
+                hit = self.prefix_cache.match(eff[:n_use * bs])
+                if hit.blocks and hit.tokens >= n_use * bs:
+                    self.prefix_cache.acquire(hit)
+                else:
+                    hit, n_use = None, 0
+        total = self._blocks_needed(req)
+        blocks = self.allocator.alloc(total - n_use)
+        if blocks is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict(
+                    (total - n_use) - self.allocator.free_count):
+                blocks = self.allocator.alloc(total - n_use)
+        if blocks is None and self.enable_spill:
+            blocks = self._spill_for(total - n_use, free)
+        if blocks is None:
+            if hit is not None:
+                self.allocator.free(hit.blocks)
+            return "wait"
+        slot = free[0]
+        t0 = self.clock()
+        imported = 0
+        parts = []
+        for s in ships:
+            s_start, s_end = int(s["start_block"]), int(s["end_block"])
+            if s_end <= n_use:
+                continue  # deduped: resident via the prefix cache
+            parts.append((str(s["artifact"]),
+                          blocks[s_start - n_use:s_end - n_use]))
+            imported += s_end - s_start
+        try:
+            if parts:
+                # the whole shipment train lands as ONE scatter per pool
+                # array — admission stall stays off the decode-round tail
+                self.engine.import_pool_block_batch(parts)
+        except KVBlockIntegrityError as e:
+            self.allocator.free(blocks)
+            if hit is not None:
+                self.allocator.free(hit.blocks)
+            self._ship_reject(req, gen, str(e))
+            return "fallback"
+        # all shipments resident: the slot's committed length lands ONCE
+        self.engine.set_slot_length(slot, len(eff))
+        imp_dur = self.clock() - t0
+        self.queue.popleft()
+        free.pop(0)
+        self._shipments.pop(req.id, None)
+        slot_blocks = (list(hit.blocks)[:n_use] if hit is not None
+                       else []) + blocks
+        row = np.zeros((self.engine.max_blocks_per_slot,), np.int32)
+        row[:len(slot_blocks)] = slot_blocks
+        self.block_tables[slot] = row
+        self._slot_blocks[slot] = slot_blocks
+        if self.prefix_cache is not None:
+            # the imported row covers the committed prompt — cache it so
+            # sibling prompts dedupe against it, exactly as prefill would
+            self.prefix_cache.insert(eff, slot_blocks)
+            self.prefix_cache.note_admission(n_use * bs, len(eff))
+            self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
+        self._trace(req, "queue", dur=self.clock() - submitted_at,
+                    slot=slot)
+        st = self.active[slot] = _Slot(req, committed[-1], submitted_at,
+                                       self.clock())
+        self.ship_imports += 1
+        self._m_ship_imports.inc(len(ships))
+        self._m_handoff_shipped.inc(imported)
+        self._audit_ship("import", req.id, int(ships[-1].get("seq", 0)),
+                         gen, n_use, n_ship_blocks,
+                         f"{imported} imported, {n_use} deduped")
+        self._trace(req, "shipment_import", dur=imp_dur,
+                    shipments=len(ships), blocks=imported, deduped=n_use)
+        self._trace(req, "first_token",
+                    ttft=st.first_token_at - st.submitted_at)
+        self.max_concurrent = max(self.max_concurrent, len(self.active))
+        if (self.eos_token_id is not None
+                and st.tokens[-1] == self.eos_token_id):
+            self._finish(slot, "eos", done)
+        elif len(st.tokens) >= req.max_new_tokens:
+            self._finish(slot, "length", done)
+        return "imported"
+
+    def _ship_reject(self, req: Request, gen: int, detail: str) -> None:
+        self._shipments.pop(req.id, None)
+        self.ship_rejects += 1
+        self._m_ship_rejected.inc()
+        self._audit_ship("reject", req.id, -1, gen, 0, 0, detail)
+        logger.warning("Shipment import of request %s rejected (%s); "
+                       "falling back to committed-prefix replay", req.id,
+                       detail)
+        self._trace(req, "ship_reject", detail=detail)
+
     def _abort_pending_prefill(self) -> None:
         """Drain landed while packed rows were mid-prompt: free every
         pending row's blocks exactly once (fresh, COW and acquired shared
@@ -1410,6 +1779,7 @@ class Scheduler:
         for p in reversed(self._pending_prefill):
             self.allocator.free(p.blocks)
             self.block_tables[p.slot] = 0
+            self._ship_state.pop(p.request.id, None)
             self.queue.appendleft((p.request, p.submitted_at))
         self._pending_prefill.clear()
         self.stop_admission()
@@ -1435,6 +1805,10 @@ class Scheduler:
                     ttft=st.first_token_at - st.submitted_at)
         self.max_concurrent = max(self.max_concurrent, len(self.active))
         self._m_tokens.inc()  # the prefill's first token
+        if self.role == "prefill":
+            # same prefill-engine contract as the sequential lane
+            self._finish(p.slot, "prefill", done)
+            return
         if (self.eos_token_id is not None
                 and st.tokens[-1] == self.eos_token_id):
             self._finish(p.slot, "eos", done)
@@ -1482,6 +1856,9 @@ class Scheduler:
         for (p, m), tok in zip(batch, toks):
             self._count_chunk()
             p.pos += m
+            if self.role == "prefill":
+                # packed analogue of the sequential lane's per-chunk ship
+                self._ship_commit(p.request, p.blocks, p.eff, p.pos)
             if p.pos >= len(p.eff):
                 self._pending_prefill.remove(p)
                 self._finish_prefill(p, tok, done)
@@ -1915,6 +2292,12 @@ class Scheduler:
             for q in (50, 95, 99):
                 out[f"{name}_p{q}_ms"] = float(
                     np.percentile(arr, q) * 1e3)
+        out["engine_role"] = self.role
+        if self.role != "both" or self.ship_exports or self.ship_imports \
+                or self.ship_rejects:
+            out["ship_exports"] = self.ship_exports
+            out["ship_imports"] = self.ship_imports
+            out["ship_rejects"] = self.ship_rejects
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count

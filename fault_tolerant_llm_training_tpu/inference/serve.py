@@ -75,6 +75,11 @@ _M_KV_BYTES_PER_BLOCK = REGISTRY.gauge(
 _M_KV_DTYPE = REGISTRY.gauge(
     "kv_dtype",
     "Paged KV pool storage dtype as an info label (kv_dtype{dtype=...} 1)")
+_M_ENGINE_ROLE = REGISTRY.gauge(
+    "engine_role",
+    "Disaggregated serving role as an info label "
+    "(engine_role{engine_role=...} 1); serve.py is always the colocated "
+    "'both' — dedicated prefill/decode roles are fleet.py --role")
 
 
 class _RequestFollower:
@@ -444,6 +449,7 @@ def main(argv=None) -> None:
             bpb = block_bytes(engine.cache)
             _M_KV_BYTES_PER_BLOCK.set(bpb)
             _M_KV_DTYPE.labels(dtype=engine.kv_dtype).set(1)
+        _M_ENGINE_ROLE.labels(engine_role="both").set(1)
         if args.spec_k:
             engine.draft_restored_step = draft_step_restored
             logger.info(

@@ -41,6 +41,18 @@ Span names with a fixed meaning across the fleet (payloads free-form):
     migration     router fenced the dead src and re-admitted on dst
                   (payload: src, dst, gen, replayed = committed prefix
                   length the survivor must replay bit-exactly)
+    block_ship    a prefill-role engine exported one incremental block
+                  shipment as a chunk committed (dur = export seconds;
+                  payload: seq, blocks, bytes, length) — emitted on the
+                  PREFILL host, so the stitched trace crosses the
+                  prefill-host -> decode-host boundary
+    decode_placement  router transferred ownership prefill -> decode
+                  host after prefill_done (payload: src, dst, gen,
+                  shipments = verified artifacts named in the record)
+    shipment_import   the decode engine imported the shipped blocks at
+                  admission (dur = verify+import seconds; payload:
+                  shipments, blocks, deduped = prefix-cache-hit blocks
+                  NOT re-imported)
     requeue       drain persisted this request back to the journal
     done          request finished (payload: reason, tokens, ttft, tpot)
 
@@ -289,6 +301,13 @@ def derive(spans: List[Dict]) -> Dict:
     stall_s = sum(float(r.get("dur", 0.0))
                   for r in by_name.get("reload_pause", ()))
     decode_rounds = len(by_name.get("decode_round", ()))
+    # Disaggregated pipeline legs: export time on the prefill host plus
+    # verify+import time on the decode host — the price of the split,
+    # sitting right on the stitched critical path between them.
+    ship_s = sum(float(r.get("dur", 0.0))
+                 for r in by_name.get("block_ship", ()))
+    import_s = sum(float(r.get("dur", 0.0))
+                   for r in by_name.get("shipment_import", ()))
 
     # Wall-clock critical path: every span in time order with the host
     # that emitted it — the "where did this request spend its time" view.
@@ -314,6 +333,10 @@ def derive(spans: List[Dict]) -> Dict:
         "prefill_seconds": prefill_s,
         "reload_stall_seconds": stall_s,
         "decode_rounds": decode_rounds,
+        "ship_seconds": ship_s,
+        "shipment_import_seconds": import_s,
+        "disaggregated": bool(by_name.get("decode_placement")
+                              or by_name.get("block_ship")),
         "wall_seconds": (t1 - t0) if (t0 is not None and t1 is not None)
                         else None,
         "critical_path": path,

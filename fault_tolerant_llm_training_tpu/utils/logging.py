@@ -158,3 +158,17 @@ AUDIT_HANDOFF_FMT = ("[HANDOFF] Block-shipment {action} request {id} "
 AUDIT_KV_QUANT_FMT = ("[KV QUANT] dtype={dtype} | {bytes_per_block} "
                       "B/block ({ratio:.2f}x vs bf16) | {blocks_total} "
                       "pool block(s)")
+
+# --- Disaggregated prefill/decode audit trail (inference/scheduler.py,
+# inference/router.py, inference/fleet.py) — the prefill->decode pipeline's
+# grep surface: every incremental block shipment a prefill engine exports,
+# every verified/rejected import on a decode engine, and the router's
+# role-aware placements (including the placement-time mixed-dtype
+# rejection). scripts/chaos_campaign.py's disagg scenario and
+# tests/test_disagg.py grep these, frozen in tests/test_audit_contract.py
+# like the rest. ---
+AUDIT_DISAGG_SHIP_FMT = ("[DISAGG] Shipment {action} request {id} seq "
+                         "{seq} (gen {gen}): blocks [{start}, {end}), "
+                         "{detail}")
+AUDIT_DISAGG_PLACE_FMT = ("[DISAGG] Placement {action} request {id} "
+                          "(gen {gen}): {detail}")

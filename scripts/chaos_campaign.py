@@ -49,6 +49,19 @@ periodic checkpoints every 5 steps):
                including the migrated, mid-decode ones — bit-matches an
                unfailed single-host reference serve
 
+  disagg       disaggregated prefill/decode serving (inference/fleet.py
+               --role): two dedicated prefill engines stream committed
+               KV blocks to one dedicated decode engine over the
+               checksummed artifact path; chaos SIGKILLs prefill host
+               pre0 mid-prompt (prefill_kill, between chunk commits) so
+               the router re-prefills its requests on pre1, and flips a
+               payload byte in one of pre1's shipments (ship_corrupt,
+               manifest spared) so the router CRC-rejects exactly that
+               shipment and hands the request to decode as a committed-
+               prefix replay. Zero requests lost, every engine drains
+               leak-clean, and all four decode streams bit-match an
+               unfailed colocated reference serve
+
 Bit-exactness evidence: full-precision ``loss`` floats from the step
 events, compared against a clean baseline run with the same seed; for
 ckpt_corrupt, additionally the integrity manifest of the fallback step dir
@@ -82,7 +95,7 @@ from fault_tolerant_llm_training_tpu.obs import reqtrace  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
-             "loader_stall", "deploy", "fleet", "tiered")
+             "loader_stall", "deploy", "fleet", "tiered", "disagg")
 # Known container-level post-restore native crash codes (SIGABRT/SIGSEGV,
 # as rc or negative signal): the resumed process dies after the restore
 # audits are flushed. Survival is then judged on the audit trail.
@@ -913,6 +926,207 @@ def run_tiered_scenario(work: str, parquet: str, seed: int) -> Result:
     return res
 
 
+def run_disagg_scenario(work: str, parquet: str, seed: int) -> Result:
+    """Disaggregated prefill/decode scenario: two dedicated prefill
+    engines stream committed KV blocks to one dedicated decode engine
+    over the checksummed artifact path; chaos kills one prefill host
+    mid-prompt and poisons one of the survivor's shipments (module
+    docstring)."""
+    res = Result("disagg")
+    base = os.path.join(work, "disagg")
+    ckpts = os.path.join(base, "ckpts")
+    events_dir = os.path.join(ckpts, "events")
+    os.makedirs(base, exist_ok=True)
+    job = "disagg_a"
+
+    rc, out = _run(_train_argv(parquet, ckpts, seed,
+                               **{"--training-steps": "10",
+                                  "--checkpoint-frequency": "5"}), job)
+    if not res.check(rc == 0, f"disagg training checkpoint committed "
+                              f"(got rc {rc})"):
+        return res
+
+    store = os.path.join(base, "store")
+    jdir = os.path.join(base, "journal")
+    intake = os.path.join(base, "intake.jsonl")
+    # Long prompts (70+ byte-tokens against 32-token prefill chunks):
+    # every prefill takes >= 3 chunk commits, so the prefill_kill at
+    # chunk ordinal 1 lands MID-PROMPT and the incremental pipeline
+    # ships more than one artifact per request.
+    prompts = [
+        "alpha bravo charlie delta echo foxtrot golf hotel india "
+        "juliett kilo lima",
+        "mike november oscar papa quebec romeo sierra tango uniform "
+        "victor whiskey",
+        "zulu yankee xray whiskey victor uniform tango sierra romeo "
+        "quebec papa oscar",
+        "one two three four five six seven eight nine ten eleven "
+        "twelve thirteen fourteen",
+    ]
+    reqs = []
+    for i, prompt in enumerate(prompts):
+        r = {"id": f"req{i}", "prompt": prompt, "max_new_tokens": 48,
+             "temperature": 0.0, "seed": seed + 21 + i}
+        if i == 3:
+            r["temperature"] = 0.8
+        reqs.append(r)
+    with open(intake, "w") as fh:
+        for r in reqs:
+            fh.write(json.dumps(r) + "\n")
+
+    def host_argv(hid, role, extra=()):
+        return [sys.executable, "-m",
+                "fault_tolerant_llm_training_tpu.inference.fleet",
+                "--host-id", hid, "--store", store, "--journal-dir", jdir,
+                "--checkpoint-path", ckpts, "--checkpoint-job-id", job,
+                "--model", "tiny", "--tokenizer-name-or-path", "byte",
+                "--max-len", "256", "--prefill-buckets", "16,32",
+                "--no-eos", "--lease-ttl", "2.0",
+                "--max-run-seconds", "240", "--seed", str(seed),
+                "--role", role,
+                "--event-log",
+                os.path.join(base, f"events_{hid}.jsonl")] + list(extra)
+
+    # pre0: SIGKILLed between its 2nd chunk's commit and its shipment
+    # export — shipments stop mid-prompt, the router must re-prefill on
+    # pre1. pre1: chaos flips a payload byte in its 5th shipment export
+    # (manifest spared) — the router must CRC-reject exactly that
+    # shipment and degrade that request to a committed-prefix replay.
+    pre0 = _ServeDriver(host_argv(
+        "pre0", "prefill",
+        ["--slots", "2", "--chaos", "step=1:prefill_kill"]), "disagg_pre0")
+    pre1 = _ServeDriver(host_argv(
+        "pre1", "prefill",
+        ["--slots", "2", "--chaos", "step=4:ship_corrupt"]), "disagg_pre1")
+    d0 = _ServeDriver(host_argv("d0", "decode", ["--slots", "4"]),
+                      "disagg_d0")
+    router = None
+    try:
+        res.check(pre0.wait_for(r"\[FLEET\] Host pre0 joined", timeout=420)
+                  is not None, "prefill host pre0 joined the fleet")
+        res.check(pre1.wait_for(r"\[FLEET\] Host pre1 joined", timeout=420)
+                  is not None, "prefill host pre1 joined the fleet")
+        res.check(d0.wait_for(r"\[FLEET\] Host d0 joined", timeout=420)
+                  is not None, "decode host d0 joined the fleet")
+        router = _ServeDriver(
+            [sys.executable, "-m",
+             "fault_tolerant_llm_training_tpu.inference.router",
+             "--store", store, "--journal-dir", jdir, "--intake", intake,
+             "--expected", "4", "--max-seconds", "180",
+             "--poll-seconds", "0.1",
+             "--event-log", os.path.join(base, "events_router.jsonl")],
+            "disagg_router")
+        rrc = router.finish(timeout=200)
+        res.check(rrc == 0, f"router completed and exited 0 (got {rrc})")
+        rc_pre0 = pre0.finish(timeout=15)
+        pre1.proc.send_signal(_signal.SIGUSR1)
+        rc_pre1 = pre1.finish(timeout=120)
+        d0.proc.send_signal(_signal.SIGUSR1)
+        rc_d0 = d0.finish(timeout=120)
+    finally:
+        for drv in (pre0, pre1, d0, router):
+            if drv is not None and drv.proc.poll() is None:
+                drv.proc.kill()
+                drv.finish(timeout=10)
+    rout = router.output()
+    out_pre0, out_pre1, out_d0 = pre0.output(), pre1.output(), d0.output()
+
+    # --- prefill-side faults
+    res.check(rc_pre0 == -9
+              and "[CHAOS] Injected prefill_kill" in out_pre0,
+              f"prefill host pre0 SIGKILLed mid-prompt by chaos "
+              f"(rc {rc_pre0})")
+    res.check("[FLEET] Host pre0 declared dead" in rout
+              and "fencing and migrating" in rout,
+              "router declared pre0 dead and fenced it")
+    res.check(re.search(r"\[FLEET\] Migrating request req\d+: "
+                        r"pre0 -> pre1", rout) is not None,
+              "dead host's mid-prompt requests re-prefilled on the "
+              "surviving prefill peer")
+    res.check("[CHAOS] Injected ship_corrupt" in out_pre1
+              and "Corrupted block shipment" in out_pre1,
+              "chaos flipped a payload byte in one of pre1's shipments "
+              "(manifest spared)")
+
+    # --- the CRC gate: exactly the poisoned shipment rejected, its
+    # request degraded to replay; every request still reached decode
+    rejects = re.findall(r"\[DISAGG\] Shipment reject request (req\d+) "
+                         r"seq (\d+)", rout)
+    res.check(len(rejects) == 1,
+              f"router CRC-rejected exactly the poisoned shipment "
+              f"(rejects {rejects})")
+    places = re.findall(r"\[DISAGG\] Placement decode request (req\d+)",
+                        rout)
+    res.check(sorted(places) == [r["id"] for r in reqs],
+              f"every request handed to the decode engine exactly once "
+              f"(placements {sorted(places)})")
+    res.check(re.search(r"Fleet router complete: 4 request\(s\) done, "
+                        r"\d+ migrated, 0 lost", rout) is not None,
+              "zero requests lost: all 4 served")
+
+    # --- decode side: imports for the clean shipments, replay for the
+    # rejected one, and the streams all come off the decode engine
+    res.check(len(re.findall(r"Request req\d+ output: ", out_d0)) == 4
+              and "Request req" not in
+              "\n".join(l for l in out_pre1.splitlines()
+                        if "output:" in l),
+              "all four streams decoded on the dedicated decode engine")
+    res.check(rc_pre1 == 0
+              and "Fleet drain leak guard: clean" in out_pre1,
+              f"prefill survivor drained leak-clean and exited 0 "
+              f"(got rc {rc_pre1})")
+    res.check(rc_d0 == 0 and "Fleet drain leak guard: clean" in out_d0,
+              f"decode engine drained leak-clean and exited 0 "
+              f"(got rc {rc_d0})")
+
+    # --- bit-exactness: one unfailed COLOCATED serve, same prompts,
+    # seeds and prefill chunking — every disaggregated stream must match
+    ref_reqs = os.path.join(base, "ref_requests.jsonl")
+    shutil.copy(intake, ref_reqs)
+    ref = _ServeDriver(_serve_argv(ckpts, job, [
+        "--prefill-buckets", "16,32", "--seed", str(seed), "--follow",
+        "--poll-seconds", "0.2", "--request-file", ref_reqs]),
+        "disagg_ref")
+    try:
+        for r in reqs:
+            res.check(ref.wait_for(rf"Request {r['id']} output: ",
+                                   timeout=420) is not None,
+                      f"reference serve completed {r['id']}")
+        ref.proc.send_signal(_signal.SIGUSR1)
+        ref_rc = ref.finish()
+    finally:
+        if ref.proc.poll() is None:
+            ref.proc.kill()
+            ref.finish(timeout=10)
+    res.check(ref_rc == 0, f"reference serve exited 0 (got {ref_rc})")
+    disagg_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                     out_d0))
+    ref_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                  ref.output()))
+    res.check(
+        len(disagg_outputs) == 4 and all(
+            disagg_outputs.get(f"req{i}") == ref_outputs.get(f"req{i}")
+            for i in range(4)),
+        "disaggregated streams (shipped-block imports and the CRC-reject "
+        "replay alike) bit-identical to the unfailed colocated reference")
+
+    # --- request-trace stitch: every trail crosses into the decode host
+    # and is flagged disaggregated (block_ship/decode_placement spans)
+    traced = {r["request_id"]: r
+              for r in reqtrace.stitch([base]) if r["request_id"]}
+    trace_ok = len(traced) == 4
+    for r in reqs:
+        tr = traced.get(r["id"])
+        trace_ok = (trace_ok and tr is not None
+                    and bool(tr.get("disaggregated"))
+                    and "d0" in set(tr.get("hosts", ())))
+    res.check(trace_ok,
+              "stitched trace: all four requests flagged disaggregated "
+              "with the decode host on the critical path")
+    _stitch_scenario(res, events_dir)
+    return res
+
+
 def format_report(results, seed: int, wall: float, extra_notes) -> str:
     lines = []
     lines.append("Chaos survival campaign")
@@ -992,6 +1206,8 @@ def main(argv=None) -> int:
             res = run_fleet_scenario(work, parquet, args.seed)
         elif name == "tiered":
             res = run_tiered_scenario(work, parquet, args.seed)
+        elif name == "disagg":
+            res = run_disagg_scenario(work, parquet, args.seed)
         else:
             res = run_scenario(name, work, parquet, args.seed,
                                baseline_losses, sbatch=args.sbatch)

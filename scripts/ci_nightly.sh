@@ -20,7 +20,13 @@
 #    chaos corrupts one handoff and one spill artifact, the router and
 #    the survivor CRC-reject exactly the poisoned ones and fall back to
 #    committed-prefix replay, all streams bit-match an unfailed
-#    reference);
+#    reference), and the disagg scenario (two dedicated prefill engines
+#    stream committed KV-block shipments to a dedicated decode engine;
+#    chaos SIGKILLs one prefill host mid-prompt — its requests
+#    re-prefill on the surviving peer — and flips a byte in one
+#    shipment, which the router CRC-rejects into committed-prefix
+#    replay; zero lost, every engine drains leak-clean, and all streams
+#    bit-match an unfailed colocated reference);
 # 3. shared_prefix decode bench — re-runs the prefix-caching scenario
 #    and holds it to the committed BENCH_decode_prefix_cpu.json
 #    acceptance bars: cached N=8 prefill <= 2x N=1 and
@@ -66,7 +72,13 @@
 #    admission gate >= 1x, and the held-out-shard perplexity shift
 #    stays under a 5% ceiling (greedy flips are recorded, never
 #    pinned); then compiles the fused-dequant parity check at D=64 and
-#    D=128 over the adversarial pool matrix and requires it green.
+#    D=128 over the adversarial pool matrix and requires it green;
+# 10. disagg bench — re-runs the disaggregated-vs-colocated scenario at
+#    equal total slots/blocks and pins the BENCH_disagg_cpu.json bars:
+#    colocated p99 decode-round latency (~TPOT) under the long-prompt
+#    burst exceeds the dedicated decode engine's (> 1x; the magnitude
+#    is machine-dependent), zero dropped requests on either side, and
+#    the disaggregated streams bit-match the colocated ones.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -82,7 +94,7 @@ echo "== slow-marked suite"
 python -m pytest tests/ -q -m slow --continue-on-collection-errors \
     -p no:cacheprovider -p no:randomly
 
-echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered drills)"
+echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered/disagg drills)"
 export FAKE_SLURM_DIR="$WORK/slurm"
 cat > "$WORK/requeue.sh" <<EOF
 #!/bin/bash
@@ -166,6 +178,34 @@ do
     fi
 done
 echo "ok: tiered drill (handoff export -> CRC gate -> import-or-replay, spill -> reject -> replay) checks present"
+
+# the disagg drill's substance: a prefill engine was SIGKILLed
+# mid-prompt and its requests re-prefilled on the surviving prefill
+# peer, chaos poisoned one of the survivor's block shipments and the
+# router CRC-rejected exactly that one into committed-prefix replay,
+# every request decoded on the dedicated decode engine, both surviving
+# engines drained leak-clean, and all streams bit-matched an unfailed
+# colocated reference serve
+for want in \
+    "ok: prefill host pre0 SIGKILLed mid-prompt by chaos (rc -9)" \
+    "ok: router declared pre0 dead and fenced it" \
+    "ok: dead host's mid-prompt requests re-prefilled on the surviving prefill peer" \
+    "ok: chaos flipped a payload byte in one of pre1's shipments (manifest spared)" \
+    "ok: router CRC-rejected exactly the poisoned shipment" \
+    "ok: every request handed to the decode engine exactly once" \
+    "ok: zero requests lost: all 4 served" \
+    "ok: all four streams decoded on the dedicated decode engine" \
+    "ok: prefill survivor drained leak-clean and exited 0" \
+    "ok: decode engine drained leak-clean and exited 0" \
+    "ok: disaggregated streams (shipped-block imports and the CRC-reject replay alike) bit-identical to the unfailed colocated reference" \
+    "ok: stitched trace: all four requests flagged disaggregated with the decode host on the critical path"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: disagg drill check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: disagg drill (prefill kill -> re-prefill, ship corrupt -> CRC reject -> replay, decode placement) checks present"
 
 echo "== shared_prefix bench vs committed receipt"
 python scripts/decode_bench.py --scenario shared_prefix \
@@ -368,6 +408,36 @@ print(f"ok: int8 {got['blocks_ratio']}x blocks at "
       f"{ppl['perplexity_rel_delta']:+.4f} (|ceil| {PPL_REL_CEIL})")
 EOF
 
+echo "== disagg bench vs committed receipt"
+python scripts/decode_bench.py --scenario disagg \
+    --out "$WORK/bench_disagg.json"
+python - "$WORK/bench_disagg.json" BENCH_disagg_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+ratio = got["decode_p99_tpot_interference_ratio"]
+assert ratio > 1.0, (
+    f"disaggregation bought nothing: colocated/disagg p99 decode-round "
+    f"ratio {ratio}x (must beat colocated at equal total capacity)")
+assert got["dropped"] == 0, (
+    f"{got['dropped']} request(s) dropped under the disagg split")
+assert got["bit_exact"], (
+    "disaggregated streams diverged from the colocated reference — the "
+    "shipped-block import path is no longer bit-exact")
+assert got["split"]["prefill_slots"] + got["split"]["decode_slots"] \
+    == got["slots_total"], "split does not sum to the colocated capacity"
+assert want["decode_p99_tpot_interference_ratio"] > 1.0 \
+    and want["bit_exact"], "committed receipt is stale"
+print(f"ok: disagg decode p99 {ratio}x better than colocated under the "
+      f"long-prompt burst ({got['requests']} requests, "
+      f"{got['split']['prefill_slots']}+{got['split']['decode_slots']} "
+      f"vs {got['slots_total']} slots, "
+      f"{got['disaggregated']['shipments_per_long_request']} shipments "
+      f"per long request), 0 dropped, bit-exact")
+EOF
+
 echo "== fused-dequant parity check (int8 KV, D=64/128)"
 python - <<'EOF'
 import sys
@@ -381,4 +451,4 @@ assert ok, "quantized decode parity check failed"
 print("ok: fused-dequant kernels within error bounds at D=64 and D=128")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity)"
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg)"

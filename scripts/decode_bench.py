@@ -59,7 +59,7 @@ def main():
                    choices=("uniform", "long_context", "spec_decode",
                             "shared_prefix", "fused_decode",
                             "mixed_prefill", "tree_spec", "serving_load",
-                            "spill_preempt", "kv_quant"))
+                            "spill_preempt", "kv_quant", "disagg"))
     p.add_argument("--burst-ns", default="1,4,8",
                    help="fused_decode scenario: comma-separated burst "
                         "lengths (tokens per dispatch) to sweep")
@@ -157,6 +157,8 @@ def main():
         result = _spill_preempt(args, vocab)
     elif args.scenario == "kv_quant":
         result = _kv_quant(args, vocab)
+    elif args.scenario == "disagg":
+        result = _disagg(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -170,7 +172,8 @@ def main():
                     "tree_spec": "BENCH_decode_tree",
                     "serving_load": "BENCH_serving_latency",
                     "spill_preempt": "BENCH_kv_spill",
-                    "kv_quant": "BENCH_kv_quant"}.get(
+                    "kv_quant": "BENCH_kv_quant",
+                    "disagg": "BENCH_disagg"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -1568,6 +1571,232 @@ def _kv_quant(args, vocab):
             "perplexity_delta": round(ppl["int8"] - ppl["bf16"], 4),
             "perplexity_rel_delta": round(
                 (ppl["int8"] - ppl["bf16"]) / ppl["bf16"], 6),
+        },
+    }
+
+
+def _disagg(args, vocab):
+    """Disaggregated prefill/decode vs colocated at EQUAL total capacity.
+
+    The interference a colocated server can't hide: a burst of long
+    prompts lands while short interactive streams are decoding, and
+    every scheduler iteration that runs a 64-token prefill chunk delays
+    the next token of every active decode stream by that chunk's
+    compute. Splitting the same 4 slots / same block pool into a
+    2-slot prefill engine and a 2-slot decode engine moves the chunk
+    work off the decode host entirely — the decode engine only ever
+    imports committed block shipments (the device puts the colocated
+    path never pays) and runs pure decode rounds.
+
+    Both systems serve the identical seeded workload: steady short
+    requests (mixed greedy/sampled) plus a same-tick burst of long
+    prompts. The disaggregated pipeline is pumped in one process, so
+    per-request Completion wall-clocks would charge the decode engine
+    for prefill compute it never runs on its own host; instead both
+    sides sample PER-DECODE-ROUND latency — the wall time of each
+    scheduler iteration entered with at least one active decode slot,
+    which is exactly the TPOT a caller streaming tokens observes
+    (one committed token per active stream per round). The colocated
+    samples include whatever prefill chunks shared the iteration; the
+    decode engine's include its shipment imports. Each mode takes the
+    best of two measured runs after a warmup pass.
+
+    Receipt bars (pinned by scripts/ci_nightly.sh):
+
+    - ``decode_p99_tpot_interference_ratio`` > 1.0 — colocated p99
+      decode-round latency over disaggregated, at equal total slots
+      and blocks;
+    - ``dropped`` == 0 — every submitted request completes, on the
+      decode engine for the disaggregated side;
+    - ``bit_exact`` — the disaggregated streams (shipped-block imports,
+      greedy and sampled alike) match the colocated streams token for
+      token, every repeat.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+    from fault_tolerant_llm_training_tpu.obs.registry import MetricRegistry
+
+    cfg = get_config(args.model, vocab_size=vocab, seq_len=256,
+                     layer_impl=args.layer_impl)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    bs, buckets, max_len = 8, (16, 32, 64), 256
+    n_short, short_prompt, short_gen = 8, 16, 32
+    n_long, long_prompt, long_gen = 4, 192, 8
+    repeats = 2
+
+    def build(slots):
+        return InferenceEngine(cfg, params, slots=slots, max_len=max_len,
+                               prefill_buckets=buckets, kv_layout="paged",
+                               kv_block_size=bs)
+
+    # equal total capacity: 4 slots / 128 blocks colocated, split 2+2
+    # slots / 64+64 blocks disaggregated (kv_num_blocks defaults to
+    # slots * max_len / block_size on both sides)
+    colo = build(4)
+    pre_eng, dec_eng = build(2), build(2)
+
+    wrng = np.random.default_rng(args.seed + 5)
+    requests, arrivals = [], []
+    for i in range(n_short):
+        kw = ({} if i % 2 == 0 else
+              {"temperature": 0.8, "top_p": 0.9})
+        requests.append(Request(
+            id=f"short{i}",
+            prompt=wrng.integers(3, vocab, size=short_prompt).tolist(),
+            max_new_tokens=short_gen, seed=100 + i, **kw))
+        arrivals.append(2 * i)
+    for i in range(n_long):
+        requests.append(Request(
+            id=f"long{i}",
+            prompt=wrng.integers(3, vocab, size=long_prompt).tolist(),
+            max_new_tokens=long_gen, seed=200 + i))
+        arrivals.append(3)                       # the same-tick burst
+    order = sorted(range(len(requests)), key=lambda i: arrivals[i])
+    n = len(requests)
+
+    def clone(r, **extra):
+        return Request(id=r.id, prompt=list(r.prompt),
+                       max_new_tokens=r.max_new_tokens,
+                       temperature=r.temperature, top_p=r.top_p,
+                       seed=r.seed, **extra)
+
+    def drive_colocated():
+        colo.reset()
+        sched = Scheduler(colo, eos_token_id=None,
+                          registry=MetricRegistry())
+        samples, submitted, tick = [], 0, 0
+        while submitted < n or sched.pending():
+            while submitted < n and arrivals[order[submitted]] <= tick:
+                sched.submit(clone(requests[order[submitted]]))
+                submitted += 1
+            if sched.pending():
+                decoding = bool(sched.active)
+                t0 = time.monotonic()
+                sched.step()
+                if decoding:
+                    samples.append(time.monotonic() - t0)
+            tick += 1
+        streams = {c.request_id: c.tokens for c in sched.completed}
+        return samples, streams, len(sched.completed)
+
+    def drive_disagg(ship_dir):
+        pre_eng.reset()
+        dec_eng.reset()
+        ships = {}
+
+        def on_ship(req, art_dir, ordinal, seq, start, end, length):
+            ships.setdefault(req.id, []).append(
+                {"artifact": art_dir, "seq": seq, "start_block": start,
+                 "end_block": end, "length": length})
+
+        pre = Scheduler(pre_eng, eos_token_id=None, role="prefill",
+                        ship_dir=ship_dir, on_ship=on_ship,
+                        registry=MetricRegistry())
+        dec = Scheduler(dec_eng, eos_token_id=None, role="decode",
+                        registry=MetricRegistry())
+        samples, submitted, handed, tick = [], 0, 0, 0
+        while len(dec.completed) < n:
+            while submitted < n and arrivals[order[submitted]] <= tick:
+                pre.submit(clone(requests[order[submitted]]))
+                submitted += 1
+            if pre.pending():
+                pre.step()                       # the prefill host's clock
+            for c in pre.completed[handed:]:
+                r = next(q for q in requests if q.id == c.request_id)
+                dec.submit(clone(r, committed=tuple(c.tokens)),
+                           shipments=ships.get(r.id), ship_gen=0)
+            handed = len(pre.completed)
+            if dec.pending():
+                decoding = bool(dec.active)
+                t0 = time.monotonic()
+                dec.step()                       # the decode host's clock
+                if decoding:
+                    samples.append(time.monotonic() - t0)
+            tick += 1
+        streams = {c.request_id: c.tokens for c in dec.completed}
+        return samples, streams, len(dec.completed)
+
+    def p99(samples):
+        return float(np.percentile(np.asarray(samples) * 1000.0, 99))
+
+    def p50(samples):
+        return float(np.percentile(np.asarray(samples) * 1000.0, 50))
+
+    # warmup compiles every bucket, the decode programs, and the
+    # shipment export/import paths on both sides
+    warm_dir = tempfile.mkdtemp(prefix="disagg_warm_")
+    try:
+        drive_colocated()
+        drive_disagg(warm_dir)
+    finally:
+        shutil.rmtree(warm_dir, ignore_errors=True)
+
+    colo_runs, dis_runs, bit_exact, dropped = [], [], True, 0
+    ref_streams = None
+    for _ in range(repeats):
+        ship_dir = tempfile.mkdtemp(prefix="disagg_bench_")
+        try:
+            c_samples, c_streams, c_done = drive_colocated()
+            d_samples, d_streams, d_done = drive_disagg(ship_dir)
+        finally:
+            shutil.rmtree(ship_dir, ignore_errors=True)
+        dropped += (n - c_done) + (n - d_done)
+        bit_exact = bit_exact and (c_streams == d_streams)
+        if ref_streams is None:
+            ref_streams = c_streams
+        bit_exact = bit_exact and (c_streams == ref_streams)
+        colo_runs.append(c_samples)
+        dis_runs.append(d_samples)
+
+    colo_best = min(colo_runs, key=p99)
+    dis_best = min(dis_runs, key=p99)
+    ratio = p99(colo_best) / p99(dis_best)
+    return {
+        "bench": "disagg",
+        "scenario": "disagg",
+        "model": args.model,
+        "backend": jax.default_backend(),
+        "metric": (f"colocated / disaggregated p99 decode-round latency "
+                   f"(~TPOT) under a same-tick long-prompt burst "
+                   f"({args.model}, vocab {vocab}, 4 slots total both "
+                   f"sides, {n_short} short prompt {short_prompt} gen "
+                   f"{short_gen} mixed greedy/sampled + {n_long} long "
+                   f"prompt {long_prompt} gen {long_gen}, chunk "
+                   f"{max(buckets)}, block size {bs}, best of {repeats}, "
+                   f"backend {jax.default_backend()})"),
+        "value": round(ratio, 3),
+        "unit": "x p99 decode-round latency, colocated over disaggregated",
+        "decode_p99_tpot_interference_ratio": round(ratio, 3),
+        "dropped": int(dropped),
+        "bit_exact": bool(bit_exact),
+        "requests": n,
+        "slots_total": 4,
+        "split": {"prefill_slots": 2, "decode_slots": 2},
+        "kv_block_size": bs,
+        "prefill_buckets": list(buckets),
+        "colocated": {
+            "decode_round_p50_ms": round(p50(colo_best), 3),
+            "decode_round_p99_ms": round(p99(colo_best), 3),
+            "decode_rounds_sampled": len(colo_best),
+        },
+        "disaggregated": {
+            "decode_round_p50_ms": round(p50(dis_best), 3),
+            "decode_round_p99_ms": round(p99(dis_best), 3),
+            "decode_rounds_sampled": len(dis_best),
+            "shipments_per_long_request": long_prompt // max(buckets),
         },
     }
 

@@ -125,6 +125,11 @@ FROZEN = {
     "AUDIT_KV_QUANT_FMT":
         "[KV QUANT] dtype={dtype} | {bytes_per_block} B/block "
         "({ratio:.2f}x vs bf16) | {blocks_total} pool block(s)",
+    "AUDIT_DISAGG_SHIP_FMT":
+        "[DISAGG] Shipment {action} request {id} seq {seq} (gen {gen}): "
+        "blocks [{start}, {end}), {detail}",
+    "AUDIT_DISAGG_PLACE_FMT":
+        "[DISAGG] Placement {action} request {id} (gen {gen}): {detail}",
 }
 
 
