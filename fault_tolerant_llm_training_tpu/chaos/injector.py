@@ -23,13 +23,16 @@ exposes one hook per injection site:
 - :meth:`on_reload` — deploy/reload.py, keyed by reload ordinal (1 = the
   first swap): ``reload_signal`` delivers a real SIGUSR1 in the middle of
   a hot weight swap;
-- :meth:`on_handoff` / :meth:`on_spill` / :meth:`on_ship` — the tiered-KV
-  block artifacts (inference/scheduler.py spill tier and incremental
-  prefill shipments, inference/fleet.py ``--handoff`` drain), keyed by
-  export ordinal: ``handoff_corrupt`` / ``spill_corrupt`` /
-  ``ship_corrupt`` flip one payload byte AFTER the artifact's CRC
-  manifest commits, so the verify-before-import must reject it and the
-  request must degrade to committed-prefix replay;
+- :meth:`on_handoff` / :meth:`on_spill` / :meth:`on_ship` /
+  :meth:`on_store_put` — the tiered-KV block artifacts
+  (inference/scheduler.py spill tier and incremental prefill shipments,
+  inference/fleet.py ``--handoff`` drain, inference/kvstore.py store
+  publishes), keyed by export ordinal: ``handoff_corrupt`` /
+  ``spill_corrupt`` / ``ship_corrupt`` / ``store_corrupt`` flip one
+  payload byte AFTER the artifact's CRC manifest commits, so the
+  verify-before-import must reject it and the request must degrade to
+  committed-prefix replay (or, for store fetches, local chunked
+  prefill);
 - :meth:`on_prefill_chunk` — the prefill-role scheduler's chunk-commit
   boundary, keyed by completed-chunk ordinal: ``prefill_kill`` SIGKILLs
   the prefill engine mid-prompt.
@@ -321,6 +324,18 @@ class ChaosInjector:
         return self._corrupt_artifact(
             "ship_corrupt", artifact_dir, ordinal,
             what=f"block shipment {ordinal}")
+
+    def on_store_put(self, artifact_dir: str,
+                     ordinal: int = 0) -> Optional[str]:
+        """Fleet-store publish hook (inference/kvstore.py, called AFTER a
+        prefix train's manifest commits, keyed by this host's publish
+        ordinal): ``store_corrupt`` flips one payload byte with the
+        manifest spared — a fetching host's verify-before-import must
+        CRC-reject exactly this train and fall back to local chunked
+        prefill. Returns the corrupted path."""
+        return self._corrupt_artifact(
+            "store_corrupt", artifact_dir, ordinal,
+            what=f"store artifact {ordinal}")
 
     def on_spill(self, artifact_dir: str, ordinal: int = 0) -> Optional[str]:
         """Spill-tier hook (inference/scheduler.py), called AFTER a

@@ -80,6 +80,12 @@ ship_corrupt    flip a payload byte in the Nth block shipment a prefill
                 the router's verify must CRC-reject exactly that shipment
                 and hand the request to decode as a committed-prefix
                 replay instead
+store_corrupt   flip a payload byte in the Nth prefix train this host
+                publishes to the fleet-global KV store
+                (inference/kvstore.py, keyed by publish ordinal, manifest
+                spared) — a fetching host's verify-before-import must
+                CRC-reject exactly that train and degrade to local
+                chunked prefill with nothing lost
 ==============  ============================================================
 
 Steps are *global* training steps, so an entry in the past at resume time
@@ -110,6 +116,7 @@ FAULTS = {
     "spill_corrupt": None,
     "prefill_kill": None,
     "ship_corrupt": None,
+    "store_corrupt": None,
 }
 
 # The serving loop has no training steps, prefetcher or KV agreement: only
@@ -122,7 +129,7 @@ SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal", "spill_corrupt")
 # process with its own schedule, so @rank= is unnecessary there).
 FLEET_FAULTS = ("sigusr1", "sigterm", "host_kill", "heartbeat_delay",
                 "handoff_corrupt", "spill_corrupt", "prefill_kill",
-                "ship_corrupt")
+                "ship_corrupt", "store_corrupt")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ENTRY_RE = re.compile(

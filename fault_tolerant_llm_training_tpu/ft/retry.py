@@ -13,6 +13,7 @@ Clock and sleep are injectable so tests drive the deadline without
 wall-clock waits, mirroring the fake-clock idiom in the pod fault fence.
 """
 
+import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
@@ -48,6 +49,7 @@ def retry_with_backoff(
     sleep: Callable[[float], None] = time.sleep,
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
     what: str = "kv-store op",
+    jitter_seed: Optional[int] = None,
 ):
     """Call ``fn()`` until it succeeds or the deadline elapses.
 
@@ -56,11 +58,20 @@ def retry_with_backoff(
     ``deadline_seconds`` before the caller gets its verdict. Backoff
     doubles from ``base_delay`` up to ``max_delay`` and is clipped to the
     time remaining, so the final sleep never overshoots the deadline.
+
+    ``jitter_seed`` enables seeded FULL jitter: each sleep draws uniformly
+    from ``[0, min(delay, remaining))`` instead of sleeping the cap
+    exactly, which decorrelates the store-fetch / lease / pointer-watcher
+    callers that otherwise dogpile shared state on identical schedules.
+    Seeded, not wall-clock-random, so a retry trace replays exactly under
+    a fixed seed; ``None`` (the default) keeps the deterministic
+    full-delay behavior every existing caller pins.
     """
     if deadline_seconds <= 0:
         raise ValueError(f"deadline_seconds must be > 0, got {deadline_seconds}")
     deadline = clock() + deadline_seconds
     delay = base_delay
+    rng = random.Random(jitter_seed) if jitter_seed is not None else None
     attempts = 0
     last_error: Optional[BaseException] = None
     while True:
@@ -78,5 +89,6 @@ def retry_with_backoff(
             if remaining <= 0:
                 raise RetryDeadlineExceeded(what, deadline_seconds, attempts,
                                             last_error)
-            sleep(min(delay, remaining))
+            cap = min(delay, remaining)
+            sleep(rng.uniform(0.0, cap) if rng is not None else cap)
             delay = min(delay * 2.0, max_delay)

@@ -88,6 +88,7 @@ from .engine import (
 )
 from .journal import RequestJournal, persist_unserved
 from .kv_cache import bf16_block_bytes, block_bytes
+from .kvstore import BlockStore
 from .scheduler import Request, Scheduler
 
 ROUTER_JOURNAL = "router.jsonl"
@@ -216,9 +217,10 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                    help="fault schedule: host_kill / sigusr1 / sigterm "
                         "keyed by decode iteration (serve.py convention); "
                         "heartbeat_delay keyed by fleet loop iteration; "
-                        "handoff_corrupt / spill_corrupt / ship_corrupt "
-                        "keyed by export ordinal; prefill_kill keyed by "
-                        "completed-prefill-chunk ordinal")
+                        "handoff_corrupt / spill_corrupt / ship_corrupt / "
+                        "store_corrupt keyed by export ordinal; "
+                        "prefill_kill keyed by completed-prefill-chunk "
+                        "ordinal")
     p.add_argument("--handoff", action="store_true",
                    help="on a signal drain, ship in-flight requests' "
                         "committed KV blocks as checksummed artifacts "
@@ -230,6 +232,14 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                         "exhaustion, preempt the coldest request's blocks "
                         "into checksummed artifacts under this directory "
                         "and restore on demand")
+    p.add_argument("--kv-store-dir", default="",
+                   help="fleet-global KV block store root "
+                        "(inference/kvstore.py): publish every finished "
+                        "prefill's full-block KV train as a checksummed, "
+                        "content-addressed artifact and fetch the deepest "
+                        "published prefix before each local prefill; a "
+                        "CRC reject or miss degrades to the ordinary "
+                        "local chunked prefill")
     p.add_argument("--role", default="both",
                    choices=("both", "prefill", "decode"),
                    help="disaggregated pipeline role: 'prefill' admits "
@@ -307,6 +317,11 @@ def main(argv=None) -> None:
                          length, gens.get(req.id, 0),
                          trace_id=req.trace_id)
 
+        # writer IS the lease host id: the store journal's residency
+        # evidence must key by the same names the router's capacity
+        # estimates use, or cache-affinity placement never matches
+        kv_store = (BlockStore(args.kv_store_dir, writer=args.host_id)
+                    if args.kv_store_dir else None)
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id),
@@ -322,7 +337,10 @@ def main(argv=None) -> None:
                                    else None),
                           on_prefill_chunk=(chaos.on_prefill_chunk
                                             if chaos is not None
-                                            else None))
+                                            else None),
+                          kv_store=kv_store,
+                          on_store_put=(chaos.on_store_put
+                                        if chaos is not None else None))
     _M_ENGINE_ROLE.labels(engine_role=args.role).set(1)
 
     store = FileKVStore(args.store)

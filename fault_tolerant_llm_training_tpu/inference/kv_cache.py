@@ -659,6 +659,16 @@ def artifact_bytes(manifest: Dict) -> int:
     return sum(int(f["size"]) for f in manifest.get("files", {}).values())
 
 
+def block_payload(cache: PagedKVCache, block: int) -> bytes:
+    """One pool block's host-side payload bytes, in :func:`block_layout`
+    segment order — byte-identical to what :func:`export_blocks` writes
+    for that block, which is what lets tests assert a store/ship
+    roundtrip bitwise without re-exporting."""
+    return b"".join(
+        np.asarray(seg["array"][int(block)]).tobytes()
+        for seg in block_layout(cache))
+
+
 def cache_pspec() -> P:
     """(slots|blocks, kv_heads, positions, head_dim): slots/blocks replicated
     — every device decodes every request — only the heads shard: kv_heads

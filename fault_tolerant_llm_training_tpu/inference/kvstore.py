@@ -1,0 +1,339 @@
+"""Fleet-global, content-addressed KV-block store.
+
+Per-host prefix caches (PR 6) stop paying once a fleet serves one shared
+system prompt across many hosts: every host the router picks re-prefills
+the SAME blocks. This module makes fully-committed prefix trains
+fleet-visible, Mooncake-style, over primitives the repo already trusts:
+
+- **Keys ARE content addresses.** ``prefix_cache.chain_hashes`` keys each
+  full block by the running hash of every token up to and including it, so
+  a train of ``n`` leading blocks is globally identified by its terminal
+  chain hash (hex). Identical prefixes hash identically on every host —
+  dedup is free, publish of an already-resident key is a no-op.
+- **Artifacts are the PR 13 CRC-manifested form.** Publish is an
+  ``export_blocks`` into ``<root>/trains/<key>/``; the manifest commits
+  last via tmp+fsync+rename, so a host SIGKILLed mid-put leaves a
+  missing-manifest directory that is simply invisible (``has`` checks the
+  manifest), never silent garbage. Fetch lands through the PR 15
+  verify-before-first-device-write batch import; any CRC reject degrades
+  to local chunked prefill — corruption costs recompute, never
+  correctness.
+- **State is journaled like everything else.** ``<root>/journal/`` holds
+  per-writer fsync'd JSONL (``put`` / ``touch`` / ``ref`` / ``unref`` /
+  ``evict``); :meth:`BlockStore.fold` reduces it to per-train state, a
+  restarted sweeper re-folds and re-migrates nothing. In-flight fetches
+  hold journaled refcounts, so fleet-global LRU eviction
+  (:meth:`BlockStore.sweep`) can never pull a train out from under an
+  importer; an ``unref`` below zero is corruption worth raising on,
+  exactly like the request journal's prefix-divergence check.
+
+The store itself is pure mechanism — audit lines, metrics and reqtrace
+spans for publish/fetch decisions are emitted by the scheduler
+(``[KV STORE]`` / ``kv_store_*``), and cache-affinity placement lives in
+``router.py`` (:meth:`BlockStore.affinity` feeds its ``pick_host`` key).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from .kv_cache import BLOCK_MANIFEST_NAME, export_blocks
+
+__all__ = ["BlockStore", "StoreHit", "TrainState", "main"]
+
+_TRAINS_DIR = "trains"
+_JOURNAL_DIR = "journal"
+
+
+@dataclass(frozen=True)
+class StoreHit:
+    """One matching train: ``depth`` full blocks ending at chain ``key``."""
+    key: str
+    depth: int
+    art_dir: str
+
+
+@dataclass
+class TrainState:
+    """Folded view of one train across every store-journal file."""
+    key: str
+    blocks: int = 0
+    bytes: int = 0
+    length: int = 0
+    host: str = ""                 # publisher
+    put_t: float = 0.0
+    last_use: float = 0.0          # LRU clock: newest put/touch/ref
+    refs: int = 0                  # open ref - unref (in-flight fetches)
+    hosts: Set[str] = field(default_factory=set)  # residency evidence
+    evicted: bool = False          # newest put-vs-evict record is evict
+
+
+class BlockStore:
+    """One host's handle on the shared store directory.
+
+    ``writer`` names this participant's journal file (one appender per
+    file, the request-journal discipline — concurrent hosts never
+    interleave bytes, a SIGKILL tears at worst the killer's own tail).
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, root: str, writer: str,
+                 clock: Callable[[], float] = time.time):
+        if "/" in writer or writer.startswith("."):
+            raise ValueError(f"bad store writer name: {writer!r}")
+        self.root = root
+        self.writer = writer
+        self.clock = clock
+        self.puts = 0              # publish ordinal (chaos keying)
+        self._seq = 0              # per-writer record counter (fold order)
+        self._held: Set[tuple] = set()  # (key, owner) refs THIS handle holds
+        os.makedirs(os.path.join(root, _TRAINS_DIR), exist_ok=True)
+        os.makedirs(os.path.join(root, _JOURNAL_DIR), exist_ok=True)
+        self._journal_path = os.path.join(root, _JOURNAL_DIR,
+                                          f"{writer}.jsonl")
+
+    # ------------------------------------------------------------ journal
+    def _append(self, rec: Dict) -> None:
+        rec = dict(rec, t=float(self.clock()), w=self.writer,
+                   seq=self._seq)
+        self._seq += 1
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with open(self._journal_path, "a") as fh:
+            fh.write(line)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    # ------------------------------------------------------------ paths
+    def train_dir(self, key: str) -> str:
+        return os.path.join(self.root, _TRAINS_DIR, key)
+
+    def has(self, key: str) -> bool:
+        """A train is visible iff its manifest committed — the atomic
+        rename in ``export_blocks`` makes this the torn-put filter."""
+        return os.path.isfile(os.path.join(self.train_dir(key),
+                                           BLOCK_MANIFEST_NAME))
+
+    # ------------------------------------------------------------ lookup
+    def match(self, keys: Sequence[bytes]) -> Optional[StoreHit]:
+        """Deepest resident train matching the chain-hash ladder ``keys``
+        (``chain_hashes`` output, one hash per full block), or None."""
+        for i in range(len(keys) - 1, -1, -1):
+            key = keys[i].hex()
+            if self.has(key):
+                return StoreHit(key=key, depth=i + 1,
+                                art_dir=self.train_dir(key))
+        return None
+
+    # ------------------------------------------------------------ publish
+    def publish(self, cache, keys: Sequence[bytes],
+                blocks: Sequence[int], *, length: int,
+                meta: Optional[Dict] = None,
+                on_put: Optional[Callable[[str, int], None]] = None
+                ) -> Optional[Dict]:
+        """Export pool rows ``blocks`` (the train's full prefix blocks, in
+        order) as the train keyed by ``keys[-1]``. Dedup: an already-
+        visible key publishes nothing and returns None. ``on_put`` is the
+        chaos hook (``store_corrupt``, keyed by this handle's publish
+        ordinal), called after the artifact commits and BEFORE the journal
+        record — the same ordering the fleet's ship hook uses. Returns the
+        manifest, or None when deduped."""
+        if len(blocks) != len(keys) or not keys:
+            raise ValueError(
+                f"train needs one key per block: {len(keys)} key(s) for "
+                f"{len(blocks)} block(s)")
+        key = keys[-1].hex()
+        if self.has(key):
+            return None
+        art_dir = self.train_dir(key)
+        if os.path.isdir(art_dir):
+            # torn remains of a killed publisher: no manifest, so the key
+            # was never visible — finish the death, then re-export
+            shutil.rmtree(art_dir)
+        manifest = export_blocks(
+            cache, list(blocks), art_dir, length=int(length),
+            meta=dict(meta or {}, kind="store", key=key,
+                      keys=[k.hex() for k in keys]))
+        nbytes = sum(int(f["size"]) for f in manifest["files"].values())
+        ordinal = self.puts
+        self.puts += 1
+        if on_put is not None:
+            on_put(art_dir, ordinal)
+        self._append({"kind": "put", "key": key, "blocks": len(blocks),
+                      "bytes": nbytes, "length": int(length),
+                      "host": self.writer})
+        return manifest
+
+    # ------------------------------------------------------------ refcounts
+    def acquire(self, key: str, owner: str) -> None:
+        """Journal a fetch-in-flight reference: the sweeper skips
+        refcounted trains, so the artifact cannot be evicted between
+        ``match`` and the verify-import."""
+        held = (key, owner)
+        if held in self._held:
+            raise ValueError(f"double acquire of train {key} by {owner}")
+        self._held.add(held)
+        self._append({"kind": "ref", "key": key, "owner": owner})
+
+    def release(self, key: str, owner: str) -> None:
+        """Drop a reference this handle holds; releasing one it does not
+        hold is a refcount bug, raised exactly like the allocator's
+        double-free."""
+        held = (key, owner)
+        if held not in self._held:
+            raise ValueError(f"double release of train {key} by {owner}")
+        self._held.remove(held)
+        self._append({"kind": "unref", "key": key, "owner": owner})
+
+    def touch(self, key: str) -> None:
+        """LRU use marker (journaled): a successful fetch touches the
+        train, and the toucher becomes residency evidence for the
+        router's affinity map."""
+        self._append({"kind": "touch", "key": key, "host": self.writer})
+
+    # ------------------------------------------------------------ fold
+    def _read_records(self) -> List[Dict]:
+        recs: List[Dict] = []
+        root = os.path.join(self.root, _JOURNAL_DIR)
+        try:
+            names = sorted(os.listdir(root))
+        except FileNotFoundError:
+            return recs
+        for name in names:
+            if not name.endswith(".jsonl"):
+                continue
+            with open(os.path.join(root, name)) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        recs.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail of a SIGKILLed writer
+        recs.sort(key=lambda r: (float(r.get("t", 0.0)),
+                                 str(r.get("w", "")),
+                                 int(r.get("seq", 0))))
+        return recs
+
+    def fold(self) -> Dict[str, TrainState]:
+        """Reduce every journal file to per-train state. Idempotent — a
+        restarted sweeper folds to exactly the state the dead one saw. An
+        ``unref`` that would drive a train's refcount negative raises:
+        refs are the only thing standing between an importer and the
+        sweeper, so an unbalanced pair is corruption, not noise."""
+        states: Dict[str, TrainState] = {}
+        for rec in self._read_records():
+            key = rec.get("key")
+            if not key:
+                continue
+            st = states.get(key)
+            if st is None:
+                st = states[key] = TrainState(key=key)
+            kind = rec.get("kind")
+            t = float(rec.get("t", 0.0))
+            if kind == "put":
+                st.blocks = int(rec.get("blocks", 0))
+                st.bytes = int(rec.get("bytes", 0))
+                st.length = int(rec.get("length", 0))
+                st.host = str(rec.get("host", ""))
+                st.put_t = t
+                st.last_use = max(st.last_use, t)
+                st.hosts.add(st.host)
+                st.evicted = False  # re-publish after evict resurrects
+            elif kind == "touch":
+                st.last_use = max(st.last_use, t)
+                if rec.get("host"):
+                    st.hosts.add(str(rec["host"]))
+            elif kind == "ref":
+                st.refs += 1
+                st.last_use = max(st.last_use, t)
+            elif kind == "unref":
+                st.refs -= 1
+                if st.refs < 0:
+                    raise ValueError(
+                        f"store journal double release for train {key}: "
+                        f"refcount went negative")
+            elif kind == "evict":
+                st.evicted = True
+        return states
+
+    def resident(self) -> Dict[str, TrainState]:
+        """Folded trains that are actually fetchable: journaled, not
+        evicted, manifest on disk."""
+        return {k: st for k, st in self.fold().items()
+                if not st.evicted and self.has(k)}
+
+    def resident_bytes(self) -> int:
+        return sum(st.bytes for st in self.resident().values())
+
+    # ------------------------------------------------------------ affinity
+    def affinity(self, keys: Sequence[bytes]) -> Dict[str, int]:
+        """Per-host depth of the deepest resident matching train that
+        host published or touched — the router's cache-affinity signal
+        (SGLang-style: place where the longest prefix already resides)."""
+        res = self.resident()
+        depths: Dict[str, int] = {}
+        for i, k in enumerate(keys):
+            st = res.get(k.hex())
+            if st is None:
+                continue
+            for host in st.hosts:
+                depths[host] = max(depths.get(host, 0), i + 1)
+        return depths
+
+    # ------------------------------------------------------------ eviction
+    def sweep(self, max_bytes: int) -> List[str]:
+        """Fleet-global LRU: evict oldest-by-last-use unreferenced trains
+        until resident bytes fit ``max_bytes``. Half-evicted directories
+        (journaled ``evict``, directory still on disk — the sweeper died
+        mid-rmtree) are finished WITHOUT new records, which is what makes
+        a restart re-migrate nothing. Returns the evicted keys."""
+        states = self.fold()
+        for key, st in states.items():
+            if st.evicted and os.path.isdir(self.train_dir(key)):
+                shutil.rmtree(self.train_dir(key), ignore_errors=True)
+        live = [st for st in states.values()
+                if not st.evicted and self.has(st.key)]
+        total = sum(st.bytes for st in live)
+        evicted: List[str] = []
+        for st in sorted(live, key=lambda s: (s.last_use, s.key)):
+            if total <= max_bytes:
+                break
+            if st.refs > 0:
+                continue  # an importer is mid-fetch; never pull its train
+            self._append({"kind": "evict", "key": st.key})
+            shutil.rmtree(self.train_dir(st.key), ignore_errors=True)
+            total -= st.bytes
+            evicted.append(st.key)
+        return evicted
+
+
+def get_store_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Standalone store sweeper: fold the store journal and "
+                    "LRU-evict unreferenced trains down to a byte budget.")
+    p.add_argument("--store-dir", required=True,
+                   help="shared BlockStore root directory")
+    p.add_argument("--max-bytes", type=int, required=True,
+                   help="resident-bytes budget to sweep down to")
+    p.add_argument("--writer", default="sweeper",
+                   help="journal writer name for evict records")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = get_store_args(argv)
+    store = BlockStore(args.store_dir, args.writer)
+    before = store.resident_bytes()
+    evicted = store.sweep(args.max_bytes)
+    print(f"store sweep: {before} -> {store.resident_bytes()} byte(s), "
+          f"{len(evicted)} train(s) evicted")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,6 +86,12 @@ class PrefixHit:
     tokens: int
     full: bool
 
+    @property
+    def depth(self) -> int:
+        """Hit depth in whole blocks — the unit the fleet store and the
+        router's cache-affinity key compare prefixes in."""
+        return len(self.blocks)
+
 
 class PrefixCache:
     """Host-side radix tree of committed prompt blocks, refcounted through

@@ -80,6 +80,8 @@ from ..utils.logging import (
 )
 from .journal import RequestJournal, RequestState, fold
 from .kv_cache import KVBlockIntegrityError, verify_block_artifact
+from .kvstore import BlockStore
+from .prefix_cache import chain_hashes
 
 _M_HOSTS_LIVE = REGISTRY.gauge(
     "fleet_hosts_live",
@@ -114,7 +116,8 @@ class Router:
     machine over (store, journal) — the CLI below just loops it."""
 
     def __init__(self, store: FileKVStore, journal_dir: str,
-                 deadline_seconds: float = 1.0, clock=time.time):
+                 deadline_seconds: float = 1.0, clock=time.time,
+                 kv_store_dir: str = ""):
         self.lease = LeaseRegistry(store, host_id=None,
                                    deadline_seconds=deadline_seconds,
                                    clock=clock)
@@ -134,6 +137,13 @@ class Router:
         # per-host capacity estimate, reset whenever the host stamps a
         # fresh lease, decremented locally per assignment in between
         self.est: Dict[str, dict] = {}
+        # fleet-global KV store (inference/kvstore.py): read-only here —
+        # the router folds its journal for cache-affinity placement
+        # (SGLang-style: land an intake where the longest matching prefix
+        # already resides), never publishes or evicts
+        self.kv_store = (BlockStore(kv_store_dir, writer="router",
+                                    clock=clock)
+                         if kv_store_dir else None)
 
     # ---------------------------------------------------------------- intake
     def submit(self, request_id: str, prompt, max_new_tokens: int,
@@ -194,8 +204,16 @@ class Router:
         decode). A dedicated prefill host is refused at placement time —
         before its prefill ever runs — unless a decode-capable peer of
         the same kv-dtype holds a live lease, because a mixed-dtype pair
-        can never produce an importable shipment."""
+        can never produce an importable shipment.
+
+        Cache-affinity aware when a fleet KV store is configured
+        (SGLang-style): among hosts with a free slot, prefer the one
+        whose published trains cover the longest prefix of this prompt
+        — it admits with a store fetch instead of a cold prefill. A
+        free slot still dominates affinity, so a full affinity host
+        never starves an intake that a cold peer could run now."""
         stage = "decode" if item.get("committed") else "prefill"
+        depths = self._affinity_depths(item)
         best = None
         for h in sorted(self.est):
             e = self.est[h]
@@ -209,10 +227,29 @@ class Router:
                 if self._pick_decode_host(dtype) is None:
                     self._reject_place(item, h, dtype)
                     continue
-            key = (e["slots"] > 0, e["blocks"])
+            key = (e["slots"] > 0, depths.get(h, 0), e["blocks"])
             if best is None or key > best[0]:
                 best = (key, h)
         return best[1] if best else None
+
+    def _affinity_depths(self, item: dict) -> Dict[str, int]:
+        """Per-host affinity depth (whole blocks of this item's prompt
+        resident in trains that host published or fetched), from one
+        fold of the fleet store journal. Empty when no store is wired
+        — the placement key then degrades to the classic
+        (free slot, free blocks) pair."""
+        if self.kv_store is None:
+            return {}
+        depths: Dict[str, int] = {}
+        prompt = list(item["prompt"]) + list(item.get("committed", ()))[:-1]
+        cache: Dict[int, Dict[str, int]] = {}
+        for h, e in self.est.items():
+            bs = e["block_size"]
+            if bs not in cache:
+                cache[bs] = self.kv_store.affinity(chain_hashes(prompt, bs))
+            if h in cache[bs]:
+                depths[h] = cache[bs][h]
+        return depths
 
     def _pick_decode_host(self, kv_dtype: Optional[str] = None
                           ) -> Optional[str]:
@@ -623,6 +660,11 @@ def get_router_args(argv=None) -> argparse.Namespace:
                         "AND every journaled request is done")
     p.add_argument("--kv-deadline", type=float, default=1.0,
                    help="bounded retry deadline per KV-store operation")
+    p.add_argument("--kv-store-dir", default="",
+                   help="fleet-global KV block store root "
+                        "(inference/kvstore.py); when set, intake "
+                        "placement prefers the host whose published "
+                        "trains cover the longest prefix of the prompt")
     p.add_argument("--tokenizer-name-or-path", default="byte")
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
@@ -658,7 +700,8 @@ def main(argv=None) -> int:
     tokenizer = load_tokenizer(args.tokenizer_name_or_path)
     store = FileKVStore(args.store)
     router = Router(store, args.journal_dir,
-                    deadline_seconds=args.kv_deadline)
+                    deadline_seconds=args.kv_deadline,
+                    kv_store_dir=args.kv_store_dir)
     follower = _IntakeFollower(args.intake, tokenizer, args)
     logger.info("Fleet router | store=%s journal=%s expecting %d "
                 "request(s)", args.store, args.journal_dir, args.expected)

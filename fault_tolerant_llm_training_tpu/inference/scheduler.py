@@ -121,6 +121,7 @@ from ..utils.logging import (
     AUDIT_DISAGG_SHIP_FMT,
     AUDIT_HANDOFF_FMT,
     AUDIT_KV_LEAK_FMT,
+    AUDIT_KV_STORE_FMT,
     AUDIT_KV_TIER_FMT,
 )
 from .kv_cache import (
@@ -131,7 +132,7 @@ from .kv_cache import (
     export_blocks,
     verify_block_artifact,
 )
-from .prefix_cache import PrefixCache
+from .prefix_cache import PrefixCache, chain_hashes
 
 logger = logging.getLogger()
 
@@ -360,7 +361,9 @@ class Scheduler:
                  role: str = "both",
                  ship_dir: Optional[str] = None,
                  on_ship: Optional[Callable] = None,
-                 on_prefill_chunk: Optional[Callable[[int], None]] = None):
+                 on_prefill_chunk: Optional[Callable[[int], None]] = None,
+                 kv_store=None,
+                 on_store_put: Optional[Callable[[str, int], None]] = None):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -442,6 +445,23 @@ class Scheduler:
         self.ship_exports = 0                  # artifact ordinal (chaos key)
         self.ship_imports = 0
         self.ship_rejects = 0
+        # Fleet-global KV store (inference/kvstore.py BlockStore): after a
+        # prefill commits, the prompt's full prefix blocks PUBLISH as a
+        # content-addressed train; at admission, a store train deeper than
+        # the local prefix-cache hit is FETCHED through the batched
+        # verify-before-first-device-write import. Any CRC reject or miss
+        # degrades to local chunked prefill — corruption costs recompute,
+        # never correctness. ``on_store_put`` is the chaos hook
+        # (store_corrupt), threaded into BlockStore.publish.
+        self.kv_store = kv_store
+        self._on_store_put = on_store_put
+        self.store_publishes = 0
+        self.store_fetches = 0
+        self.store_fetch_blocks = 0
+        self.store_rejects = 0
+        if self.kv_store is not None and self.kv_layout != "paged":
+            raise ValueError("the fleet KV store requires the paged KV "
+                             "layout (trains are block artifacts)")
         if self.enable_spill and self.kv_layout != "paged":
             raise ValueError("the spill tier requires the paged KV layout")
         if self.enable_spill and int(getattr(engine, "spec_k", 0) or 0):
@@ -682,6 +702,31 @@ class Scheduler:
             "Shipment admissions rejected by CRC/metadata/coverage "
             "verification (the request falls back to committed-prefix "
             "replay on the decode engine)")
+        self._m_store_hits = r.counter(
+            "kv_store_hits_total",
+            "Admissions that landed a fleet-store prefix train instead of "
+            "prefilling it (verified cross-host fetches)")
+        self._m_store_fetch_blocks = r.counter(
+            "kv_store_fetch_blocks_total",
+            "KV blocks imported from fleet-store trains (CRC-verified "
+            "before the first device write)")
+        self._m_store_rejected = r.counter(
+            "kv_store_crc_rejected_total",
+            "Fleet-store fetches rejected by CRC/metadata verification "
+            "(the request falls back to local chunked prefill)")
+        self._m_store_bytes = r.gauge(
+            "kv_store_bytes",
+            "Resident payload bytes in the fleet-global KV store "
+            "(journal-folded, as of this host's last publish/fetch)")
+        self._m_store_hit_depth = r.histogram(
+            "kv_store_hit_depth",
+            "Blocks imported per fleet-store hit (train depth at the "
+            "admitting host)",
+            buckets=SPEC_TOKEN_BUCKETS)
+        self._m_store_publishes = r.counter(
+            "kv_store_publish_total",
+            "Committed prefix trains published to the fleet store "
+            "(deduped re-publishes of an identical chain hash excluded)")
         # Content-addressed prefix reuse: only engines that OPT IN get the
         # cache (InferenceEngine sets enable_prefix_cache in paged mode;
         # test doubles without the attribute keep plain allocation).
@@ -932,6 +977,15 @@ class Scheduler:
                     continue
                 # "fallback": shipment rejected — the replay path below
                 # re-derives the stream bit-exactly from prompt+committed
+            if (self.kv_store is not None and self.prefix_cache is not None
+                    and not self.spec_k):
+                # Fleet-store fetch: land the deepest published train
+                # matching this prompt in the LOCAL prefix cache first, so
+                # every admission lane below (sequential, packed, full-hit
+                # COW, drain rollback) sees it as an ordinary deep prefix
+                # hit. A miss or CRC reject changes nothing — the local
+                # chunked prefill below IS the fallback.
+                self._maybe_store_fetch(req)
             # replay admissions prefill prompt + committed[:-1]; every
             # prefix-cache and prefill path below works on this view
             eff = self._effective_prompt(req)
@@ -1130,6 +1184,7 @@ class Scheduler:
                     self.prefix_cache.insert(eff, slot_blocks)
                     self.prefix_cache.note_admission(start_pos, len(eff))
                     self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
+                    self._maybe_store_publish(req, eff, slot_blocks)
             else:
                 t0 = self.clock()
                 first = self.engine.prefill(slot, eff,
@@ -1769,6 +1824,122 @@ class Scheduler:
                        detail)
         self._trace(req, "ship_reject", detail=detail)
 
+    # --- fleet-global KV store (inference/kvstore.py) -----------------------
+
+    def _audit_store(self, action: str, key: str, rid: str, blocks: int,
+                     detail: str) -> None:
+        events.emit_audit(logger, AUDIT_KV_STORE_FMT.format(
+            action=action, key=key[:12], id=rid, blocks=blocks,
+            detail=detail), "kv_store")
+
+    def _maybe_store_fetch(self, req: Request) -> None:
+        """Fetch the deepest fleet-store train matching ``req``'s prompt
+        into the local prefix cache, when it beats the local hit depth.
+        The train lands through the batched verify-before-first-device-
+        write import into fresh blocks, is inserted under its content
+        address (the cache's own reference keeps the blocks), and the
+        normal admission then matches it like any resident prefix. The
+        in-flight fetch holds a journaled store refcount so the sweeper
+        can never evict the train mid-import; any CRC/metadata reject or
+        pool shortage leaves the pool untouched and the request on the
+        local-prefill path."""
+        bs = self.engine.block_size
+        eff = self._effective_prompt(req)
+        keys = chain_hashes(eff, bs)
+        if not keys:
+            return
+        store_hit = self.kv_store.match(keys)
+        if store_hit is None:
+            return
+        local = self.prefix_cache.match(eff)
+        n = store_hit.depth
+        if n <= local.depth:
+            return  # the local cache already covers at least as much
+        owner = f"fetch-{req.id}"
+        self.kv_store.acquire(store_hit.key, owner)
+        blocks = self.allocator.alloc(n)
+        if blocks is None:
+            if self.prefix_cache.evict(n - self.allocator.free_count):
+                blocks = self.allocator.alloc(n)
+        if blocks is None:
+            # pool pressure: not a reject — plain local admission decides
+            self.kv_store.release(store_hit.key, owner)
+            return
+        t0 = self.clock()
+        try:
+            manifest = self.engine.import_pool_block_batch(
+                [(store_hit.art_dir, blocks)])[0]
+            meta = manifest.get("meta", {})
+            if (meta.get("kind") != "store"
+                    or str(meta.get("key", "")) != store_hit.key
+                    or len(manifest.get("blocks", [])) != n):
+                raise KVBlockIntegrityError(
+                    "store train manifest disagrees with its content "
+                    "address")
+        except (KVBlockIntegrityError, OSError, ValueError) as e:
+            self.allocator.free(blocks)
+            self.kv_store.release(store_hit.key, owner)
+            self.store_rejects += 1
+            self._m_store_rejected.inc()
+            self._audit_store("reject", store_hit.key, req.id, 0, str(e))
+            logger.warning("Fleet-store fetch for request %s rejected "
+                           "(%s); falling back to local chunked prefill",
+                           req.id, e)
+            self._trace(req, "store_reject", key=store_hit.key,
+                        detail=str(e))
+            return
+        dur = self.clock() - t0
+        # content-address the imported blocks: insert takes the cache's
+        # reference, then this fetch's own allocation reference drops —
+        # exactly one holder, the ownership protocol every other resident
+        # prefix lives under. Keys the cache already holds keep their
+        # canonical block; the duplicate import rows free back to the pool.
+        self.prefix_cache.insert(eff[:n * bs], blocks)
+        self.allocator.free(blocks)
+        self.kv_store.touch(store_hit.key)
+        self.kv_store.release(store_hit.key, owner)
+        self.store_fetches += 1
+        self.store_fetch_blocks += n
+        self._m_store_hits.inc()
+        self._m_store_fetch_blocks.inc(n)
+        self._m_store_hit_depth.observe(n)
+        self._m_store_bytes.set(self.kv_store.resident_bytes())
+        self._audit_store("fetch", store_hit.key, req.id, n,
+                          f"depth {n}, {dur * 1e3:.1f} ms")
+        self._trace(req, "store_fetch", dur=dur, key=store_hit.key,
+                    depth=n, prompt_tokens=len(eff))
+
+    def _maybe_store_publish(self, req: Request, eff: Sequence[int],
+                             slot_blocks: Sequence[int]) -> None:
+        """Publish the just-committed prompt's full prefix blocks as one
+        content-addressed train. Dedup is free: identical prefixes hash
+        identically, so a key any host already published skips the export
+        outright — which also makes a fetched-then-reinserted prefix a
+        no-op here."""
+        if self.kv_store is None:
+            return
+        bs = self.engine.block_size
+        keys = chain_hashes(eff, bs)
+        if not keys or self.kv_store.has(keys[-1].hex()):
+            return
+        n = len(keys)
+        t0 = self.clock()
+        manifest = self.kv_store.publish(
+            self.engine.cache, keys, list(slot_blocks[:n]),
+            length=n * bs, meta={"request_id": req.id},
+            on_put=self._on_store_put)
+        if manifest is None:
+            return
+        dur = self.clock() - t0
+        nbytes = artifact_bytes(manifest)
+        key = keys[-1].hex()
+        self.store_publishes += 1
+        self._m_store_publishes.inc()
+        self._m_store_bytes.set(self.kv_store.resident_bytes())
+        self._audit_store("publish", key, req.id, n, f"{nbytes} byte(s)")
+        self._trace(req, "store_publish", dur=dur, key=key, blocks=n,
+                    bytes=nbytes)
+
     def _abort_pending_prefill(self) -> None:
         """Drain landed while packed rows were mid-prompt: free every
         pending row's blocks exactly once (fresh, COW and acquired shared
@@ -1795,6 +1966,7 @@ class Scheduler:
             self.prefix_cache.insert(p.eff, p.blocks)
             self.prefix_cache.note_admission(p.start_pos, len(p.eff))
             self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
+            self._maybe_store_publish(p.request, p.eff, p.blocks)
         self._check_replay(p.request, first)
         st = self.active[p.slot] = _Slot(p.request, first, p.submitted_at,
                                          self.clock())
@@ -2298,6 +2470,12 @@ class Scheduler:
             out["ship_exports"] = self.ship_exports
             out["ship_imports"] = self.ship_imports
             out["ship_rejects"] = self.ship_rejects
+        if self.kv_store is not None or self.store_publishes \
+                or self.store_fetches or self.store_rejects:
+            out["kv_store_publishes"] = self.store_publishes
+            out["kv_store_fetches"] = self.store_fetches
+            out["kv_store_fetch_blocks"] = self.store_fetch_blocks
+            out["kv_store_rejects"] = self.store_rejects
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count

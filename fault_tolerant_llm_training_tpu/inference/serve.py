@@ -63,6 +63,7 @@ from .engine import (
     restore_params,
 )
 from .kv_cache import bf16_block_bytes, block_bytes
+from .kvstore import BlockStore
 from .sampler import AdaptiveK
 from .scheduler import Request, Scheduler
 
@@ -211,6 +212,13 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "(inference/kv_cache.py), restoring them on demand "
                         "bit-exactly; '' = spill disabled (admission waits "
                         "on exhaustion instead)")
+    p.add_argument("--kv-store-dir", default="",
+                   help="fleet-global KV block store root "
+                        "(inference/kvstore.py): publish finished "
+                        "prefills' full-block KV trains as checksummed "
+                        "content-addressed artifacts and fetch the "
+                        "deepest published prefix before each local "
+                        "prefill; '' = store disabled")
     p.add_argument("--paged-kernel", default="gather",
                    choices=("gather", "pallas"),
                    help="paged attention kernel (paged layout): 'gather' "
@@ -479,7 +487,10 @@ def main(argv=None) -> None:
                           adaptive_burst=args.adaptive_burst,
                           spill_dir=args.spill_dir or None,
                           on_spill=(chaos.on_spill if chaos is not None
-                                    else None))
+                                    else None),
+                          kv_store=(BlockStore(args.kv_store_dir,
+                                               writer=f"serve_{os.getpid()}")
+                                    if args.kv_store_dir else None))
         prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
                    ) * args.repeat
         for i, text in enumerate(prompts):

@@ -53,6 +53,12 @@ Span names with a fixed meaning across the fleet (payloads free-form):
                   admission (dur = verify+import seconds; payload:
                   shipments, blocks, deduped = prefix-cache-hit blocks
                   NOT re-imported)
+    store_publish a host published this request's committed prefix train
+                  to the fleet-global KV store (dur = export seconds;
+                  payload: key, blocks, bytes)
+    store_fetch   admission landed a fleet-store train instead of
+                  prefilling it (dur = verify+import seconds; payload:
+                  key, depth = imported blocks, prompt_tokens)
     requeue       drain persisted this request back to the journal
     done          request finished (payload: reason, tokens, ttft, tpot)
 

@@ -172,3 +172,13 @@ AUDIT_DISAGG_SHIP_FMT = ("[DISAGG] Shipment {action} request {id} seq "
                          "{detail}")
 AUDIT_DISAGG_PLACE_FMT = ("[DISAGG] Placement {action} request {id} "
                           "(gen {gen}): {detail}")
+
+# --- Fleet-global KV store audit trail (inference/kvstore.py via
+# inference/scheduler.py) — the content-addressed block store's grep
+# surface: publishes of committed prefix trains, verified cross-host
+# fetches with their hit depth, CRC rejects (which degrade to local
+# chunked prefill), and the sweeper's LRU evictions. The campaign's
+# kvstore scenario and tests/test_kv_store.py grep these, frozen in
+# tests/test_audit_contract.py like the rest. ---
+AUDIT_KV_STORE_FMT = ("[KV STORE] {action} key {key} request {id}: "
+                      "{blocks} block(s), {detail}")

@@ -49,6 +49,17 @@ periodic checkpoints every 5 steps):
                including the migrated, mid-decode ones — bit-matches an
                unfailed single-host reference serve
 
+  kvstore      fleet-global KV-block store (inference/kvstore.py): two
+               fleet hosts share a content-addressed store; h0 publishes
+               the four requests' shared prefix train, chaos poisons
+               exactly that artifact (store_corrupt, manifest spared)
+               and later SIGKILLs h0 mid-decode; cache-affinity routing
+               still lands the second request on h0 while the overflow
+               goes to h1, whose one fetch CRC-rejects and degrades to
+               local recompute. Exactly one publish, exactly one reject,
+               zero lost, no torn store state, and every stream
+               bit-matches an unfailed single-host reference serve
+
   disagg       disaggregated prefill/decode serving (inference/fleet.py
                --role): two dedicated prefill engines stream committed
                KV blocks to one dedicated decode engine over the
@@ -95,7 +106,8 @@ from fault_tolerant_llm_training_tpu.obs import reqtrace  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
-             "loader_stall", "deploy", "fleet", "tiered", "disagg")
+             "loader_stall", "deploy", "fleet", "tiered", "disagg",
+             "kvstore")
 # Known container-level post-restore native crash codes (SIGABRT/SIGSEGV,
 # as rc or negative signal): the resumed process dies after the restore
 # audits are flushed. Survival is then judged on the audit trail.
@@ -1127,6 +1139,219 @@ def run_disagg_scenario(work: str, parquet: str, seed: int) -> Result:
     return res
 
 
+def run_kvstore_scenario(work: str, parquet: str, seed: int) -> Result:
+    """Fleet-global KV store scenario: poison the one published train
+    (store_corrupt) and SIGKILL the publishing host mid-decode — the
+    fetching host CRC-rejects exactly once, degrades to local recompute,
+    the router's cache-affinity placement still lands the second request
+    on the publisher, zero requests are lost, and every stream
+    bit-matches an unfailed single-host reference serve (module
+    docstring)."""
+    res = Result("kvstore")
+    base = os.path.join(work, "kvstore")
+    ckpts = os.path.join(base, "ckpts")
+    events_dir = os.path.join(ckpts, "events")
+    os.makedirs(base, exist_ok=True)
+    job = "kvstore_a"
+
+    rc, out = _run(_train_argv(parquet, ckpts, seed,
+                               **{"--training-steps": "10",
+                                  "--checkpoint-frequency": "5"}), job)
+    if not res.check(rc == 0, f"kvstore training checkpoint committed "
+                              f"(got rc {rc})"):
+        return res
+
+    store = os.path.join(base, "store")
+    jdir = os.path.join(base, "journal")
+    kvstore_dir = os.path.join(base, "kvstore")
+    intake = os.path.join(base, "intake.jsonl")
+    # all four prompts share every FULL 16-token block (34-char shared
+    # prefix, <=13-char tails keep the block boundary inside the shared
+    # region), so they share ONE content-addressed train
+    shared = "alpha bravo charlie delta echo fox"
+    reqs = [
+        {"id": "req0", "prompt": shared + " a1",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 11},
+        {"id": "req1", "prompt": shared + " b2",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 12},
+        {"id": "req2", "prompt": shared + " c3",
+         "max_new_tokens": 48, "temperature": 0.0, "seed": seed + 13},
+        {"id": "req3", "prompt": shared + " d4",
+         "max_new_tokens": 48, "temperature": 0.8, "seed": seed + 14},
+    ]
+
+    def host_argv(hid, chaos):
+        return [sys.executable, "-m",
+                "fault_tolerant_llm_training_tpu.inference.fleet",
+                "--host-id", hid, "--store", store, "--journal-dir", jdir,
+                "--kv-store-dir", kvstore_dir,
+                "--checkpoint-path", ckpts, "--checkpoint-job-id", job,
+                "--model", "tiny", "--tokenizer-name-or-path", "byte",
+                "--slots", "2", "--max-len", "256", "--no-eos",
+                "--lease-ttl", "2.0", "--max-run-seconds", "240",
+                "--seed", str(seed), "--chaos", chaos,
+                "--event-log", os.path.join(base, f"events_{hid}.jsonl")]
+
+    # h0 is the publisher: its first (and only) put is poisoned at
+    # publish ordinal 0, then a SIGKILL at decode iteration 40 takes it
+    # out mid-decode — the kill after a committed put is what the
+    # manifest-commits-last ordering must make indistinguishable from a
+    # clean put, and the torn-tail fold must absorb its journal
+    h0 = _ServeDriver(host_argv(
+        "h0", "step=0:store_corrupt;step=40:host_kill"), "kvstore_h0")
+    h1 = _ServeDriver(host_argv("h1", ""), "kvstore_h1")
+    router = None
+    try:
+        res.check(h0.wait_for(r"\[FLEET\] Host h0 joined", timeout=420)
+                  is not None, "host h0 joined the fleet with a lease")
+        res.check(h1.wait_for(r"\[FLEET\] Host h1 joined", timeout=420)
+                  is not None, "host h1 joined the fleet with a lease")
+
+        # stage the intake: req0 alone first, so h0 publishes the shared
+        # train (poisoned) BEFORE the affinity-relevant requests arrive
+        with open(intake, "w") as fh:
+            fh.write(json.dumps(reqs[0]) + "\n")
+        router = _ServeDriver(
+            [sys.executable, "-m",
+             "fault_tolerant_llm_training_tpu.inference.router",
+             "--store", store, "--journal-dir", jdir, "--intake", intake,
+             "--kv-store-dir", kvstore_dir,
+             "--expected", "4", "--max-seconds", "180",
+             "--poll-seconds", "0.1",
+             "--event-log", os.path.join(base, "events_router.jsonl")],
+            "kvstore_router")
+        res.check(h0.wait_for(r"\[KV STORE\] publish", timeout=120)
+                  is not None,
+                  "h0 published the shared train to the fleet store")
+        res.check(h0.wait_for(r"\[CHAOS\] Injected store_corrupt",
+                              timeout=30) is not None,
+                  "chaos poisoned the published store artifact "
+                  "(manifest spared)")
+        with open(intake, "a") as fh:
+            for r in reqs[1:]:
+                fh.write(json.dumps(r) + "\n")
+        rrc = router.finish(timeout=200)
+        res.check(rrc == 0, f"router completed and exited 0 (got {rrc})")
+        rc0 = h0.finish(timeout=15)
+        h1.proc.send_signal(_signal.SIGUSR1)
+        rc1 = h1.finish(timeout=120)
+    finally:
+        for drv in (h0, h1, router):
+            if drv is not None and drv.proc.poll() is None:
+                drv.proc.kill()
+                drv.finish(timeout=10)
+    rout = router.output()
+    out0, out1 = h0.output(), h1.output()
+
+    res.check(rc0 == -9 and "[CHAOS] Injected host_kill" in out0,
+              f"publishing host h0 SIGKILLed mid-decode (rc {rc0})")
+    res.check("[FLEET] Host h0 declared dead" in rout,
+              "router declared the dead publisher and migrated its work")
+    # cache-affinity receipt: req1 arrived while h0 held the only copy
+    # of the train AND fewer free blocks than h1 — without the affinity
+    # term in pick_host it would have been placed on h1
+    assigns = {}
+    with open(os.path.join(jdir, "router.jsonl")) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "assign":
+                assigns.setdefault(str(rec.get("id")),
+                                   str(rec.get("host")))
+    res.check(assigns.get("req0") == "h0" and assigns.get("req1") == "h0",
+              f"cache-affinity placement: req1 landed with the published "
+              f"train on h0 (assigns {sorted(assigns.items())})")
+    res.check(assigns.get("req2") == "h1" and assigns.get("req3") == "h1",
+              f"free slots dominate affinity: overflow intake landed on "
+              f"the cold host h1 (assigns {sorted(assigns.items())})")
+    # the SHARED prompt train publishes exactly once fleet-wide
+    # (content-address dedup: req2/req3 hash to the same terminal key on
+    # h1 and skip the export). Migrated requests legitimately publish
+    # NEW trains — their re-prefill covers prompt + committed tokens, a
+    # longer chain with a different terminal hash — so the dedup pin is
+    # per-key, not a global publish count. Exactly ONE CRC reject (h1's
+    # first fetch; the recompute re-seeds its local cache so the next
+    # admission never re-fetches).
+    m_key = re.search(r"\[KV STORE\] publish key (\w+) request req0", out0)
+    shared_key = m_key.group(1) if m_key is not None else ""
+    n_shared = (out0 + out1).count(f"[KV STORE] publish key {shared_key}"
+                                   ) if shared_key else 0
+    n_rej = (out0 + out1).count("[KV STORE] reject")
+    res.check(m_key is not None and n_shared == 1,
+              f"content-address dedup: shared prompt train published "
+              f"exactly once fleet-wide, by h0 (got {n_shared})")
+    res.check(n_rej == 1 and "[KV STORE] reject" in out1
+              and "falling back to local chunked prefill" in out1,
+              f"exactly one CRC reject, on h1, degrading to local "
+              f"recompute (got {n_rej})")
+    res.check(re.search(r"Fleet router complete: 4 request\(s\) done, "
+                        r"\d+ migrated, 0 lost", rout) is not None,
+              "zero requests lost: all 4 served")
+    res.check(rc1 == 0 and "Fleet drain leak guard: clean" in out1,
+              f"survivor drained leak-clean and exited 0 (got rc {rc1})")
+
+    # store post-mortem: the SIGKILL left no torn state — every visible
+    # train either CRC-verifies or is the ONE poisoned artifact, and a
+    # restarted handle folds the journals (h0's torn tail included)
+    from fault_tolerant_llm_training_tpu.inference.kv_cache import (
+        KVBlockIntegrityError, verify_block_artifact)
+    from fault_tolerant_llm_training_tpu.inference.kvstore import (
+        BlockStore)
+    post = BlockStore(kvstore_dir, writer="postmortem")
+    folded = post.fold()          # raises on journal corruption
+    bad = good = 0
+    for key in folded:
+        if not post.has(key):
+            continue              # torn put: invisible by contract
+        try:
+            verify_block_artifact(post.train_dir(key))
+            good += 1
+        except KVBlockIntegrityError:
+            bad += 1
+    res.check(bad == 1,
+              f"store post-mortem: exactly the one poisoned train fails "
+              f"CRC ({bad} bad, {good} clean), no torn state survives")
+    res.check(all(st.refs == 0 for st in folded.values()),
+              "no leaked store refcounts: every journaled fetch ref was "
+              "released")
+
+    # unfailed single-host reference: every stream — fetched, locally
+    # recomputed after the reject, and migrated alike — must bit-match
+    ref_reqs = os.path.join(base, "ref_requests.jsonl")
+    with open(ref_reqs, "w") as fh:
+        for r in reqs:
+            fh.write(json.dumps(r) + "\n")
+    ref = _ServeDriver(_serve_argv(ckpts, job, [
+        "--seed", str(seed), "--follow", "--poll-seconds", "0.2",
+        "--request-file", ref_reqs]), "kvstore_ref")
+    try:
+        for r in reqs:
+            res.check(ref.wait_for(rf"Request {r['id']} output: ",
+                                   timeout=420) is not None,
+                      f"reference serve completed {r['id']}")
+        ref.proc.send_signal(_signal.SIGUSR1)
+        ref_rc = ref.finish()
+    finally:
+        if ref.proc.poll() is None:
+            ref.proc.kill()
+            ref.finish(timeout=10)
+    res.check(ref_rc == 0, f"reference serve exited 0 (got {ref_rc})")
+    fleet_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                    out0 + "\n" + out1))
+    ref_outputs = dict(re.findall(r"Request (req\d+) output: (.+)",
+                                  ref.output()))
+    res.check(
+        len(fleet_outputs) == 4 and all(
+            fleet_outputs.get(f"req{i}") == ref_outputs.get(f"req{i}")
+            for i in range(4)),
+        "store-fetched, reject-recomputed and migrated streams all "
+        "bit-identical to the unfailed single-host reference serve")
+    _stitch_scenario(res, events_dir)
+    return res
+
+
 def format_report(results, seed: int, wall: float, extra_notes) -> str:
     lines = []
     lines.append("Chaos survival campaign")
@@ -1208,6 +1433,8 @@ def main(argv=None) -> int:
             res = run_tiered_scenario(work, parquet, args.seed)
         elif name == "disagg":
             res = run_disagg_scenario(work, parquet, args.seed)
+        elif name == "kvstore":
+            res = run_kvstore_scenario(work, parquet, args.seed)
         else:
             res = run_scenario(name, work, parquet, args.seed,
                                baseline_losses, sbatch=args.sbatch)

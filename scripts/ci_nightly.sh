@@ -26,7 +26,16 @@
 #    re-prefill on the surviving peer — and flips a byte in one
 #    shipment, which the router CRC-rejects into committed-prefix
 #    replay; zero lost, every engine drains leak-clean, and all streams
-#    bit-match an unfailed colocated reference);
+#    bit-match an unfailed colocated reference), and the kvstore
+#    scenario (one host publishes a shared prompt train into the
+#    fleet-global block store, chaos poisons the published artifact and
+#    SIGKILLs the publisher mid-decode; cache-affinity routing still
+#    placed the follow-up request with the train, overflow intake
+#    landed on the cold host by slot domination, the fetching survivor
+#    CRC-rejects exactly once into local recompute, the shared train's
+#    content address published exactly once fleet-wide, a post-mortem
+#    journal fold finds no torn state and no leaked refcounts, and all
+#    streams bit-match an unfailed single-host reference);
 # 3. shared_prefix decode bench — re-runs the prefix-caching scenario
 #    and holds it to the committed BENCH_decode_prefix_cpu.json
 #    acceptance bars: cached N=8 prefill <= 2x N=1 and
@@ -78,7 +87,16 @@
 #    colocated p99 decode-round latency (~TPOT) under the long-prompt
 #    burst exceeds the dedicated decode engine's (> 1x; the magnitude
 #    is machine-dependent), zero dropped requests on either side, and
-#    the disaggregated streams bit-match the colocated ones.
+#    the disaggregated streams bit-match the colocated ones;
+# 11. global_prefix bench — re-runs the fleet-global KV store scenario
+#    (N hosts, one shared long prefix) and pins the
+#    BENCH_kv_store_cpu.json bars: cross-host prefix hit rate > 0.5
+#    (and equal to the receipt exactly — block accounting is
+#    deterministic), aggregate prefill seconds with the shared store
+#    beat N independent caches (magnitude is machine-dependent; the
+#    direction is the bar), zero dropped requests, zero CRC rejects
+#    without chaos, and every store-fed stream bit-matches the
+#    store-less reference.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -94,7 +112,7 @@ echo "== slow-marked suite"
 python -m pytest tests/ -q -m slow --continue-on-collection-errors \
     -p no:cacheprovider -p no:randomly
 
-echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered/disagg drills)"
+echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered/disagg/kvstore drills)"
 export FAKE_SLURM_DIR="$WORK/slurm"
 cat > "$WORK/requeue.sh" <<EOF
 #!/bin/bash
@@ -206,6 +224,33 @@ do
     fi
 done
 echo "ok: disagg drill (prefill kill -> re-prefill, ship corrupt -> CRC reject -> replay, decode placement) checks present"
+
+# the kvstore drill's substance: the publisher's train was poisoned and
+# the publisher SIGKILLed, cache-affinity placement still landed the
+# follow-up request with the published train, the fetching host
+# CRC-rejected exactly once into local recompute, exactly one publish
+# happened fleet-wide (content-address dedup), nothing was lost, no
+# torn store state survived the kill, and every stream bit-matched an
+# unfailed single-host reference serve
+for want in \
+    "ok: h0 published the shared train to the fleet store" \
+    "ok: chaos poisoned the published store artifact (manifest spared)" \
+    "ok: publishing host h0 SIGKILLed mid-decode (rc -9)" \
+    "ok: cache-affinity placement: req1 landed with the published train on h0" \
+    "ok: free slots dominate affinity: overflow intake landed on the cold host h1" \
+    "ok: content-address dedup: shared prompt train published exactly once fleet-wide, by h0" \
+    "ok: exactly one CRC reject, on h1, degrading to local recompute (got 1)" \
+    "ok: zero requests lost: all 4 served" \
+    "ok: store post-mortem: exactly the one poisoned train fails CRC" \
+    "ok: no leaked store refcounts: every journaled fetch ref was released" \
+    "ok: store-fetched, reject-recomputed and migrated streams all bit-identical to the unfailed single-host reference serve"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: kvstore drill check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: kvstore drill (publish -> poison -> affinity place -> CRC reject -> recompute) checks present"
 
 echo "== shared_prefix bench vs committed receipt"
 python scripts/decode_bench.py --scenario shared_prefix \
@@ -438,6 +483,50 @@ print(f"ok: disagg decode p99 {ratio}x better than colocated under the "
       f"per long request), 0 dropped, bit-exact")
 EOF
 
+echo "== global_prefix bench vs committed receipt"
+python scripts/decode_bench.py --scenario global_prefix \
+    --out "$WORK/bench_kvstore.json"
+python - "$WORK/bench_kvstore.json" BENCH_kv_store_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+rate = got["cross_host_hit_rate"]
+assert rate > 0.5, (
+    f"cross-host prefix hit rate {rate} <= 0.5 acceptance bar — the "
+    f"shared store no longer serves the fleet's common prefix")
+assert rate == want["cross_host_hit_rate"], (
+    f"hit rate is block-accounting-deterministic: got {rate}, "
+    f"receipt {want['cross_host_hit_rate']}")
+assert got["aggregate_prefill_seconds_store"] \
+    < got["aggregate_prefill_seconds_independent"], (
+    f"shared store aggregate prefill "
+    f"{got['aggregate_prefill_seconds_store']}s no longer beats "
+    f"{got['hosts']} independent caches "
+    f"({got['aggregate_prefill_seconds_independent']}s)")
+assert got["dropped"] == 0, (
+    f"{got['dropped']} request(s) dropped under the store path")
+assert got["store_rejects"] == 0, (
+    f"{got['store_rejects']} store artifact(s) CRC-rejected without "
+    f"chaos")
+assert got["store_fetches"] >= got["hosts"] - 1, (
+    f"only {got['store_fetches']} cross-host fetches for "
+    f"{got['hosts']} hosts — the store never actually fed the fleet")
+assert got["bit_exact"], (
+    "store-fed streams diverged from the store-less reference")
+assert want["bit_exact"] and want["dropped"] == 0, (
+    "committed receipt is stale")
+speedup = (got["aggregate_prefill_seconds_independent"]
+           / got["aggregate_prefill_seconds_store"])
+print(f"ok: fleet store cross-host hit rate {rate} (> 0.5, matches "
+      f"receipt), aggregate prefill {speedup:.2f}x faster than "
+      f"{got['hosts']} independent caches, "
+      f"{got['store_publishes']} publish(es)/"
+      f"{got['store_fetches']} fetch(es), 0 rejects, 0 dropped, "
+      f"bit-exact")
+EOF
+
 echo "== fused-dequant parity check (int8 KV, D=64/128)"
 python - <<'EOF'
 import sys
@@ -451,4 +540,4 @@ assert ok, "quantized decode parity check failed"
 print("ok: fused-dequant kernels within error bounds at D=64 and D=128")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg)"
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store)"

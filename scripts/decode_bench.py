@@ -59,7 +59,8 @@ def main():
                    choices=("uniform", "long_context", "spec_decode",
                             "shared_prefix", "fused_decode",
                             "mixed_prefill", "tree_spec", "serving_load",
-                            "spill_preempt", "kv_quant", "disagg"))
+                            "spill_preempt", "kv_quant", "disagg",
+                            "global_prefix"))
     p.add_argument("--burst-ns", default="1,4,8",
                    help="fused_decode scenario: comma-separated burst "
                         "lengths (tokens per dispatch) to sweep")
@@ -159,6 +160,8 @@ def main():
         result = _kv_quant(args, vocab)
     elif args.scenario == "disagg":
         result = _disagg(args, vocab)
+    elif args.scenario == "global_prefix":
+        result = _global_prefix(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -173,7 +176,8 @@ def main():
                     "serving_load": "BENCH_serving_latency",
                     "spill_preempt": "BENCH_kv_spill",
                     "kv_quant": "BENCH_kv_quant",
-                    "disagg": "BENCH_disagg"}.get(
+                    "disagg": "BENCH_disagg",
+                    "global_prefix": "BENCH_kv_store"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -603,6 +607,134 @@ def _shared_prefix(args, vocab):
         "unique_suffix_tokens": suffix_len,
         "kv_block_size": bs,
         "points": points,
+    }
+
+
+def _global_prefix(args, vocab):
+    """Fleet-global KV store: N hosts x a shared-prompt burst, with and
+    without the content-addressed block store (inference/kvstore.py).
+
+    Four simulated hosts each serve one request carrying the same
+    432-token shared prompt (27 aligned 16-position blocks) plus a
+    unique 8-token suffix. Hosts are one engine reset per host — each
+    host's prefix cache starts COLD, which is exactly the "N independent
+    caches" baseline. With the store wired, host 0 publishes its
+    committed train once and every later host admits through the batched
+    verify-before-first-device-write fetch, prefilling only its 8 suffix
+    positions; the receipt pins the cross-host hit rate (fetched tokens
+    over the remote hosts' prompt tokens, 3*432/(3*440) ~ 0.98 > 0.5),
+    the aggregate prefill seconds beating the independent baseline
+    (440 + 3*8 positions of prefill instead of 4*440), zero dropped
+    requests, and the fetched streams bit-matching the store-less runs.
+    Each mode takes the min of 3 repeats (fresh store dir per repeat so
+    dedup cannot carry across them).
+    """
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.kvstore import BlockStore
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+    from fault_tolerant_llm_training_tpu.obs.registry import MetricRegistry
+
+    # seq_len=512 for the RoPE table (tiny preset ships 128)
+    cfg = get_config(args.model, vocab_size=vocab, seq_len=512)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    bs, gen, hosts = 16, 16, 4
+    shared_len, suffix_len = 432, 8
+    prompt_len = shared_len + suffix_len
+    lrng = np.random.default_rng(args.seed + 7)
+    shared = lrng.integers(3, vocab, size=shared_len).tolist()
+    suffixes = [lrng.integers(3, vocab, size=suffix_len).tolist()
+                for _ in range(hosts)]
+    engine = InferenceEngine(cfg, params, slots=2,
+                             max_len=prompt_len + gen + bs,
+                             prefill_buckets=(16, 32, 64),
+                             kv_layout="paged", kv_block_size=bs)
+
+    def run_fleet(store_root):
+        streams = {}
+        agg = {"prefill_seconds": 0.0, "fetch_blocks": 0, "fetches": 0,
+               "publishes": 0, "rejects": 0, "completed": 0}
+        for h in range(hosts):
+            engine.enable_prefix_cache = True
+            engine.reset()  # each host's LOCAL cache starts cold
+            store = (BlockStore(store_root, writer=f"h{h}")
+                     if store_root else None)
+            sched = Scheduler(engine, eos_token_id=None,
+                              registry=MetricRegistry(), kv_store=store)
+            sched.submit(Request(id=f"h{h}",
+                                 prompt=shared + suffixes[h],
+                                 max_new_tokens=gen))
+            sched.run()
+            m = sched.metrics()
+            agg["prefill_seconds"] += m["prefill_seconds"]
+            agg["fetch_blocks"] += sched.store_fetch_blocks
+            agg["fetches"] += sched.store_fetches
+            agg["publishes"] += sched.store_publishes
+            agg["rejects"] += sched.store_rejects
+            agg["completed"] += m["requests_completed"]
+            streams.update({c.request_id: c.tokens
+                            for c in sched.completed})
+        return agg, streams
+
+    run_fleet(None)  # warmup: every bucket + the decode program
+
+    best_store = best_solo = ref_streams = None
+    for _ in range(3):
+        solo, solo_streams = run_fleet(None)
+        if ref_streams is None:
+            ref_streams = solo_streams
+        if best_solo is None or (solo["prefill_seconds"]
+                                 < best_solo["prefill_seconds"]):
+            best_solo = solo
+        root = tempfile.mkdtemp(prefix="kvstore_bench_")
+        try:
+            fleet, fleet_streams = run_fleet(root)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        fleet["bit_exact"] = fleet_streams == ref_streams
+        if best_store is None or (fleet["prefill_seconds"]
+                                  < best_store["prefill_seconds"]):
+            best_store = fleet
+
+    remote_tokens = (hosts - 1) * prompt_len
+    hit_rate = best_store["fetch_blocks"] * bs / remote_tokens
+    return {
+        "metric": (f"cross-host prefix hit rate over {hosts} hosts x one "
+                   f"shared-prompt request (shared {shared_len} + unique "
+                   f"{suffix_len} tok, gen {gen}, backend "
+                   f"{jax.default_backend()})"),
+        "value": round(hit_rate, 4),
+        "unit": "fetched tokens / remote hosts' prompt tokens",
+        "cross_host_hit_rate": round(hit_rate, 4),
+        "aggregate_prefill_seconds_store": round(
+            best_store["prefill_seconds"], 4),
+        "aggregate_prefill_seconds_independent": round(
+            best_solo["prefill_seconds"], 4),
+        "store_publishes": best_store["publishes"],
+        "store_fetches": best_store["fetches"],
+        "store_fetch_blocks": best_store["fetch_blocks"],
+        "store_rejects": best_store["rejects"],
+        "requests_expected": hosts,
+        "requests_completed": best_store["completed"],
+        "dropped": hosts - best_store["completed"],
+        "bit_exact": best_store["bit_exact"],
+        "hosts": hosts,
+        "shared_prefix_tokens": shared_len,
+        "unique_suffix_tokens": suffix_len,
+        "kv_block_size": bs,
     }
 
 

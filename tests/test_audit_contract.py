@@ -130,6 +130,9 @@ FROZEN = {
         "blocks [{start}, {end}), {detail}",
     "AUDIT_DISAGG_PLACE_FMT":
         "[DISAGG] Placement {action} request {id} (gen {gen}): {detail}",
+    "AUDIT_KV_STORE_FMT":
+        "[KV STORE] {action} key {key} request {id}: {blocks} block(s), "
+        "{detail}",
 }
 
 
