@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from ..obs import hlc
 from .retry import RetryDeadlineExceeded, retry_with_backoff
 
 __all__ = ["FileKVStore", "HostLease", "LeaseRegistry"]
@@ -122,6 +123,8 @@ class HostLease:
     age: float               # reader's now - t
     role: str = "both"       # engine role: both | prefill | decode
     kv_dtype: str = "bf16"   # paged pool storage dtype (ship geometry)
+    metrics_port: int = 0    # bound /metrics port (0 = not exporting)
+    hlc: str = ""            # holder's HLC at renewal (obs/hlc.py)
 
     @property
     def live(self) -> bool:
@@ -159,12 +162,17 @@ class LeaseRegistry:
     # ------------------------------------------------------------- holder side
     def renew(self, slots_free: int, blocks_free: int,
               block_size: int, role: str = "both",
-              kv_dtype: str = "bf16") -> bool:
+              kv_dtype: str = "bf16", metrics_port: int = 0) -> bool:
         """Stamp a fresh lease; returns False on a bounded-deadline failure
         (the caller counts a failed renewal toward its self-fence).
         ``role``/``kv_dtype`` ride in the lease value so the router can
         place by engine role and reject mixed-dtype prefill->decode pairs
-        at placement time (shipped blocks are geometry-checked artifacts)."""
+        at placement time (shipped blocks are geometry-checked artifacts).
+        ``metrics_port`` advertises the host's bound /metrics endpoint so
+        the federation aggregator (obs/federate.py) can discover scrape
+        targets from the lease sweep alone. The holder's HLC rides in the
+        value too: every lease sweep doubles as an HLC exchange, which is
+        what keeps fleet clocks causally merged without a dedicated RPC."""
         if self.host_id is None:
             raise ValueError("renew() requires a host_id")
         value = json.dumps({
@@ -172,6 +180,7 @@ class LeaseRegistry:
             "slots_free": int(slots_free), "blocks_free": int(blocks_free),
             "block_size": int(block_size), "pid": os.getpid(),
             "role": str(role), "kv_dtype": str(kv_dtype),
+            "metrics_port": int(metrics_port), "hlc": hlc.tick(),
         })
         try:
             self._retry(
@@ -226,7 +235,13 @@ class LeaseRegistry:
                     pid=int(d.get("pid", 0)),
                     age=max(0.0, now - float(d["t"])),
                     role=str(d.get("role", "both")),
-                    kv_dtype=str(d.get("kv_dtype", "bf16")))
+                    kv_dtype=str(d.get("kv_dtype", "bf16")),
+                    metrics_port=int(d.get("metrics_port", 0)),
+                    hlc=str(d.get("hlc", "")))
+                # receive event: sweeping a lease merges the holder's HLC
+                # into the reader's clock (obs/hlc.py) — the piggyback
+                # that keeps fleet clocks causal without a new RPC
+                hlc.observe(out[host].hlc)
             except (ValueError, KeyError, TypeError):
                 continue  # torn/garbage lease reads as absent, not as a crash
         return out

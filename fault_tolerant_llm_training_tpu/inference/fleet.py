@@ -274,10 +274,15 @@ def main(argv=None) -> None:
         reqtrace.configure(trace_log, job=f"fleet_{args.host_id}",
                            host=args.host_id)
     metrics_server = None
+    bound_metrics_port = 0
     if args.metrics_port:
         metrics_server = MetricsServer(port=args.metrics_port)
-        port = metrics_server.start()
-        logger.info(f"Metrics | serving /metrics on port {port}")
+        # the BOUND port (not the requested one: port 0 = ephemeral)
+        # rides in the lease value so the federation aggregator can
+        # discover scrape targets from the lease sweep alone
+        bound_metrics_port = metrics_server.start()
+        logger.info(f"Metrics | serving /metrics on port "
+                    f"{bound_metrics_port}")
 
     with flag.deferred():  # block delivery across compile + Orbax restore
         cache_dir = (DEFAULT_COMPILE_CACHE_DIR
@@ -360,7 +365,8 @@ def main(argv=None) -> None:
 
     slots_free, blocks_free, block_size = capacity()
     lease.register(slots_free, blocks_free, block_size,
-                   role=args.role, kv_dtype=engine.kv_dtype)
+                   role=args.role, kv_dtype=engine.kv_dtype,
+                   metrics_port=bound_metrics_port)
     events.emit_audit(
         logger, AUDIT_FLEET_JOIN_FMT.format(
             host=args.host_id, slots=slots_free, blocks=blocks_free,
@@ -424,7 +430,8 @@ def main(argv=None) -> None:
             chaos.on_heartbeat(it)  # heartbeat_delay: a slow-but-alive host
         slots_free, blocks_free, block_size = capacity()
         renewed = lease.renew(slots_free, blocks_free, block_size,
-                              role=args.role, kv_dtype=engine.kv_dtype)
+                              role=args.role, kv_dtype=engine.kv_dtype,
+                              metrics_port=bound_metrics_port)
         if not renewed or lease.fenced():
             # self-fence: this host can no longer prove its lease live —
             # a migrated replica may already be running, so NO further

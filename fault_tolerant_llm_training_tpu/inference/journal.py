@@ -58,6 +58,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..obs import hlc
+
 __all__ = ["RequestJournal", "RequestState", "fold", "persist_unserved"]
 
 
@@ -100,6 +102,7 @@ class RequestJournal:
         self.path = os.path.join(root, f"{writer}.jsonl")
 
     def _append(self, rec: Dict) -> None:
+        rec = dict(rec, hlc=hlc.tick())
         line = json.dumps(rec, separators=(",", ":")) + "\n"
         # open/append/fsync/close per record: slow-path simple, and the
         # journal must survive the writer being SIGKILLed at any byte.
@@ -292,6 +295,12 @@ def fold(root: str) -> Dict[str, RequestState]:
     preferred when a fenced host double-reported."""
     states: Dict[str, RequestState] = {}
     for rec in _read_records(root):
+        # The fold is a receive event for every record it reads: advance
+        # the reader's HLC past all observed writers so anything the
+        # reader journals next (a migrate, a tombstone-adjacent assign)
+        # sorts causally after the records that justified it. Pre-HLC
+        # records have no stamp and are a no-op.
+        hlc.observe(rec.get("hlc"))
         rid = rec.get("id")
         if not rid:
             continue

@@ -41,6 +41,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
+from ..obs import hlc
 from .kv_cache import BLOCK_MANIFEST_NAME, export_blocks
 
 __all__ = ["BlockStore", "StoreHit", "TrainState", "main"]
@@ -99,7 +100,7 @@ class BlockStore:
     # ------------------------------------------------------------ journal
     def _append(self, rec: Dict) -> None:
         rec = dict(rec, t=float(self.clock()), w=self.writer,
-                   seq=self._seq)
+                   seq=self._seq, hlc=hlc.tick())
         self._seq += 1
         line = json.dumps(rec, separators=(",", ":")) + "\n"
         with open(self._journal_path, "a") as fh:
@@ -227,6 +228,9 @@ class BlockStore:
         sweeper, so an unbalanced pair is corruption, not noise."""
         states: Dict[str, TrainState] = {}
         for rec in self._read_records():
+            # receive event: the folding reader's clock advances past
+            # every journaled writer (missing stamps are a no-op)
+            hlc.observe(rec.get("hlc"))
             key = rec.get("key")
             if not key:
                 continue

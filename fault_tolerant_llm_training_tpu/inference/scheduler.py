@@ -595,6 +595,10 @@ class Scheduler:
         self._m_blocks_free = r.gauge(
             "ftl_serve_kv_blocks_free",
             "Free KV cache blocks in the paged pool (block 0 excluded)")
+        self._m_blocks_total = r.gauge(
+            "ftl_serve_kv_blocks_total",
+            "Usable KV cache blocks in the paged pool (capacity; the "
+            "federation aggregator rolls free/total up per engine role)")
         self._m_block_util = r.gauge(
             "ftl_serve_kv_block_utilization",
             "Allocated / usable KV cache blocks (0-1)")
@@ -750,6 +754,7 @@ class Scheduler:
                 evictions_counter=self._m_prefix_evictions)
         if self.kv_layout == "paged":
             self._m_blocks_free.set(self.allocator.free_count)
+            self._m_blocks_total.set(self.allocator.capacity)
 
     # --- queue management --------------------------------------------------
 
@@ -2050,6 +2055,7 @@ class Scheduler:
         self._m_occupancy.set(len(self.active) / max(self.engine.slots, 1))
         if self.kv_layout == "paged":
             self._m_blocks_free.set(self.allocator.free_count)
+            self._m_blocks_total.set(self.allocator.capacity)
             util = self.allocator.used_count / max(self.allocator.capacity, 1)
             self._m_block_util.set(util)
             self.max_block_utilization = max(self.max_block_utilization, util)

@@ -32,6 +32,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from . import hlc
+
 # Event kinds with a fixed meaning across the chain (payloads are free-form):
 #   start         AUDIT_START — fresh run entered the loop
 #   resume        AUDIT_RESUME_FMT — resumed run entered the loop
@@ -74,8 +76,8 @@ class FlightRecorder:
 
     def emit(self, kind: str, step: Optional[int] = None,
              dur: Optional[float] = None, **payload) -> Dict:
-        ev = {"t": self.clock(), "kind": kind, "job": self.job,
-              "host": self.host}
+        ev = {"t": self.clock(), "hlc": hlc.tick(), "kind": kind,
+              "job": self.job, "host": self.host}
         if step is not None:
             ev["step"] = int(step)
         if dur is not None:

@@ -1,0 +1,576 @@
+"""Metrics federation: one /metrics for the whole serving fleet.
+
+PR 12 gave every process Dapper-style request traces; this module is the
+Monarch-style aggregation layer above them. Each fleet host already
+exports a per-process /metrics (obs/prometheus.py) and advertises its
+bound port in its heartbeat lease value (ft/lease.py ``metrics_port``) —
+so the aggregator needs no service discovery beyond the lease sweep it
+already trusts for liveness. A :class:`Federator` scrapes every
+live-leased host, re-exports each host's series with a ``host=`` label
+(HELP/TYPE deduped to exactly once per family), and derives the fleet
+rollups the ROADMAP's scheduler/autoscaling items consume:
+
+- ``fleet_tokens_per_sec``                     sum of per-host throughput
+- ``fleet_kv_blocks_free/total{role=}``        paged-pool capacity by
+                                               engine role (prefill
+                                               pacing reads decode free)
+- ``fleet_kv_store_resident_bytes``/``_evicted_bytes``  folded straight
+                                               from the block-store
+                                               journal (sweeper budget)
+- ``fleet_ttft_seconds``/``fleet_tpot_seconds``  cross-host histogram
+                                               merges (bucket sums are
+                                               exact: every host shares
+                                               the registry's bounds)
+                                               with p50/p95/p99 lines
+- ``fleet_slo_attainment{slo=}``               fraction of requests under
+                                               the --slo-*-ms bars, from
+                                               the merged buckets
+- ``fleet_<counter>``                          every scraped counter
+                                               family summed fleet-wide
+- ``fleet_hosts_live/stale``, ``fleet_lease_age_seconds{host=}``  a
+                                               wedged (alive-but-not-
+                                               renewing) host is visible
+                                               here BEFORE the router's
+                                               fence verdict fires
+
+Run it: ``python -m fault_tolerant_llm_training_tpu.obs.federate
+--store <fleet-store> --port 9200`` (or ``--once`` to print a single
+federated scrape — what ci_nightly's federation drill diffs against the
+per-host scrapes).
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..ft.lease import FileKVStore, LeaseRegistry
+from . import events
+
+__all__ = ["parse_metrics_text", "family_of", "Federator", "main"]
+
+# Gauges whose fleet-wide SUM is meaningful (rates and occupancies that
+# add across hosts). Everything else per-host only: averaging a ratio
+# like kv_block_utilization across heterogeneous pools is a lie.
+SUMMABLE_GAUGES = {
+    "ftl_serve_tokens_per_sec": "fleet_tokens_per_sec",
+    "ftl_serve_queue_depth": "fleet_queue_depth",
+}
+
+# Histogram families merged into fleet-wide quantiles. Exact, not an
+# approximation: every host builds these from the same registry bucket
+# bounds, so summing per-``le`` cumulative counts is the true fleet
+# distribution at bucket resolution.
+MERGED_HISTOGRAMS = {
+    "ftl_serve_ttft_seconds": "fleet_ttft_seconds",
+    "ftl_serve_tpot_seconds": "fleet_tpot_seconds",
+}
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(v[i + 1],
+                                                             v[i + 1]))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        name = body[i:eq].strip().rstrip(",")
+        if body[eq + 1] != '"':
+            raise ValueError(f"unquoted label value at {body[eq:]!r}")
+        j = eq + 2
+        buf = []
+        while body[j] != '"':
+            if body[j] == "\\":
+                buf.append(body[j:j + 2])
+                j += 2
+            else:
+                buf.append(body[j])
+                j += 1
+        labels[name] = _unescape("".join(buf))
+        i = j + 1
+        while i < len(body) and body[i] in ", ":
+            i += 1
+    return labels
+
+
+def parse_metrics_text(text: str) -> Tuple[Dict[str, Dict],
+                                           List[Tuple[str, Dict[str, str],
+                                                      float]]]:
+    """Parse Prometheus text exposition into ``(meta, samples)``.
+
+    ``meta``: family name -> {"kind", "help"} from # TYPE / # HELP lines.
+    ``samples``: ``(sample_name, labels, value)`` in document order —
+    sample_name keeps the ``_bucket``/``_sum``/``_count`` suffixes.
+    Tolerant of torn/garbage lines (a half-written scrape parses as far
+    as it goes), never raises on them."""
+    meta: Dict[str, Dict] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            meta.setdefault(name, {"kind": "untyped", "help": ""})
+            meta[name]["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            meta.setdefault(name, {"kind": "untyped", "help": ""})
+            meta[name]["kind"] = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name = line[:line.index("{")]
+                body = line[line.index("{") + 1:line.rindex("}")]
+                labels = _parse_labels(body)
+                value = float(line[line.rindex("}") + 1:].strip()
+                              .split()[0])
+            else:
+                name, _, rest = line.partition(" ")
+                labels = {}
+                value = float(rest.strip().split()[0])
+        except (ValueError, IndexError):
+            continue
+        samples.append((name, labels, value))
+    return meta, samples
+
+
+def family_of(sample_name: str, meta: Dict[str, Dict]) -> str:
+    """Map a sample back to its family: histogram samples carry
+    ``_bucket``/``_sum``/``_count`` suffixes the headers don't."""
+    if sample_name in meta:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[:-len(suffix)]
+            if meta.get(base, {}).get("kind") == "histogram":
+                return base
+    return sample_name
+
+
+def _default_fetch(host: str, port: int, timeout: float) -> str:
+    # Fleet hosts are local OS processes (the FileKVStore fleet substrate
+    # is a shared directory), so the scrape plane is loopback.
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+class _MergedHist:
+    """Cross-host histogram merge: per-``le`` cumulative bucket sums."""
+
+    def __init__(self):
+        self.buckets: Dict[float, float] = {}
+        self.sum = 0.0
+        self.count = 0.0
+
+    def add_bucket(self, le: float, cumulative: float) -> None:
+        self.buckets[le] = self.buckets.get(le, 0.0) + cumulative
+
+    def quantile(self, q: float) -> float:
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        finite = sorted(b for b in self.buckets if b != float("inf"))
+        for le in finite:
+            if self.buckets[le] >= rank:
+                return le
+        return finite[-1] if finite else 0.0
+
+    def fraction_le(self, bound: float) -> float:
+        """Fraction of observations <= ``bound`` at bucket resolution
+        (smallest bucket bound >= the requested one — conservative)."""
+        if not self.count:
+            return 1.0
+        finite = sorted(b for b in self.buckets if b != float("inf"))
+        for le in finite:
+            if le >= bound:
+                return self.buckets[le] / self.count
+        return 1.0
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    from .registry import escape_label_value
+    if not labels:
+        return ""
+    parts = [f'{k}="{escape_label_value(v)}"'
+             for k, v in sorted(labels.items())]
+    return "{" + ",".join(parts) + "}"
+
+
+class Federator:
+    """Scrape live-leased hosts, re-export + roll up. Duck-types the
+    registry interface :class:`obs.prometheus.MetricsServer` expects
+    (``render()``), so it mounts directly on the stock server."""
+
+    def __init__(self, store_root: str, kv_store_dir: Optional[str] = None,
+                 slo_ttft_ms: float = 0.0, slo_tpot_ms: float = 0.0,
+                 stale_factor: float = 0.5, timeout: float = 2.0,
+                 clock: Callable[[], float] = time.time,
+                 fetch: Optional[Callable[[str, int], str]] = None):
+        self.leases = LeaseRegistry(FileKVStore(store_root), host_id=None,
+                                    clock=clock)
+        self.kv_store_dir = kv_store_dir
+        self.slo_ttft = slo_ttft_ms / 1e3
+        self.slo_tpot = slo_tpot_ms / 1e3
+        self.stale_factor = stale_factor
+        self.timeout = timeout
+        self.clock = clock
+        self.fetch = fetch or (
+            lambda host, port: _default_fetch(host, port, self.timeout))
+        self.scrape_failures = 0
+        # stats of the last render, for the audit line / CLI summary
+        self.last: Dict[str, float] = {}
+
+    # ------------------------------------------------------------ scrape
+    def scrape(self):
+        """One sweep: (leases, tombstones, per-host parsed scrapes)."""
+        leases = self.leases.leases()
+        tombs = set(self.leases.tombstones())
+        per_host: Dict[str, Tuple[Dict, List]] = {}
+        for host in sorted(leases):
+            lease = leases[host]
+            if host in tombs or not lease.live or not lease.metrics_port:
+                continue
+            try:
+                text = self.fetch(host, lease.metrics_port)
+            except (OSError, ValueError):
+                self.scrape_failures += 1
+                continue
+            per_host[host] = parse_metrics_text(text)
+        return leases, tombs, per_host
+
+    # ------------------------------------------------------------ store fold
+    def _store_bytes(self) -> Optional[Tuple[int, int]]:
+        if not self.kv_store_dir:
+            return None
+        # Imported lazily: the aggregator must not drag jax in unless a
+        # store dir was actually configured.
+        from ..inference.kvstore import BlockStore
+        try:
+            store = BlockStore(self.kv_store_dir, writer="federator",
+                               clock=self.clock)
+            folded = store.fold()
+        except (OSError, ValueError):
+            return None
+        resident = sum(st.bytes for st in folded.values()
+                       if not st.evicted and store.has(st.key))
+        evicted = sum(st.bytes for st in folded.values() if st.evicted)
+        return resident, evicted
+
+    # ------------------------------------------------------------ render
+    def render(self) -> str:
+        leases, tombs, per_host = self.scrape()
+        lines: List[str] = []
+        emitted_headers = set()
+
+        def header(name: str, kind: str, help_text: str) -> None:
+            # exactly once per family, however many hosts carry it
+            if name in emitted_headers:
+                return
+            emitted_headers.add(name)
+            from .registry import escape_help
+            lines.append(f"# HELP {name} {escape_help(help_text)}")
+            lines.append(f"# TYPE {name} {kind}")
+
+        # ---- per-host re-export with host= label, headers deduped ----
+        families: Dict[str, Dict] = {}
+        for host, (meta, _samples) in per_host.items():
+            for name, m in meta.items():
+                if name not in families or (
+                        families[name]["kind"] == "untyped"):
+                    families[name] = m
+        counter_sums: Dict[str, float] = {}
+        gauge_sums: Dict[str, float] = {}
+        merged: Dict[str, _MergedHist] = {}
+        series = 0
+        for fam_name in sorted(families):
+            fam = families[fam_name]
+            header(fam_name, fam["kind"], fam["help"])
+            for host in sorted(per_host):
+                meta, samples = per_host[host]
+                for name, labels, value in samples:
+                    if family_of(name, meta) != fam_name:
+                        continue
+                    out_labels = dict(labels, host=host)
+                    lines.append(f"{name}{_fmt_labels(out_labels)} "
+                                 f"{_fmt_value(value)}")
+                    series += 1
+                    kind = meta.get(fam_name, {}).get("kind")
+                    if kind == "counter" and "quantile" not in labels:
+                        counter_sums[fam_name] = (
+                            counter_sums.get(fam_name, 0.0) + value)
+                    elif kind == "gauge" and fam_name in SUMMABLE_GAUGES:
+                        gauge_sums[fam_name] = (
+                            gauge_sums.get(fam_name, 0.0) + value)
+                    if fam_name in MERGED_HISTOGRAMS:
+                        h = merged.setdefault(fam_name, _MergedHist())
+                        if name.endswith("_bucket") and "le" in labels:
+                            le = (float("inf")
+                                  if labels["le"] == "+Inf"
+                                  else float(labels["le"]))
+                            h.add_bucket(le, value)
+                        elif name.endswith("_sum"):
+                            h.sum += value
+                        elif name.endswith("_count"):
+                            h.count += value
+
+        # ---- fleet rollups ----
+        rollups = 0
+        now = self.clock()
+        live = [h for h, l in leases.items()
+                if l.live and h not in tombs]
+        # a host is STALE when its lease age exceeds stale_factor * ttl
+        # but the dead verdict (age > ttl) has not fired yet: alive by
+        # the router's rules, wedged by the operator's
+        stale = [h for h in live
+                 if leases[h].age > self.stale_factor * leases[h].ttl]
+        header("fleet_hosts_live", "gauge",
+               "Live-leased, untombstoned fleet hosts at scrape time")
+        lines.append(f"fleet_hosts_live {len(live)}")
+        header("fleet_hosts_stale", "gauge",
+               "Live hosts whose lease age exceeds stale_factor*ttl — "
+               "wedged (alive-but-not-renewing), visible before the "
+               "fence verdict")
+        lines.append(f"fleet_hosts_stale {len(stale)}")
+        rollups += 2
+        header("fleet_lease_age_seconds", "gauge",
+               "Per-host heartbeat lease age as seen by the aggregator")
+        for host in sorted(leases):
+            lines.append(
+                f"fleet_lease_age_seconds{_fmt_labels({'host': host})} "
+                f"{_fmt_value(round(leases[host].age, 6))}")
+            rollups += 1
+        # KV block capacity per engine role, straight off the lease
+        # values (blocks_free) and the scraped total gauges
+        role_free: Dict[str, int] = {}
+        role_total: Dict[str, float] = {}
+        for host in live:
+            role = leases[host].role
+            role_free[role] = (role_free.get(role, 0)
+                               + leases[host].blocks_free)
+            meta_samples = per_host.get(host)
+            if meta_samples:
+                for name, labels, value in meta_samples[1]:
+                    if name == "ftl_serve_kv_blocks_total":
+                        role_total[role] = (role_total.get(role, 0.0)
+                                            + value)
+        header("fleet_kv_blocks_free", "gauge",
+               "Free paged-pool KV blocks summed over live hosts, by "
+               "engine role (prefill pacing watches role=decode)")
+        for role in sorted(role_free):
+            lines.append(
+                f"fleet_kv_blocks_free{_fmt_labels({'role': role})} "
+                f"{role_free[role]}")
+            rollups += 1
+        header("fleet_kv_blocks_total", "gauge",
+               "Paged-pool KV block capacity summed over live hosts, "
+               "by engine role")
+        for role in sorted(role_total):
+            lines.append(
+                f"fleet_kv_blocks_total{_fmt_labels({'role': role})} "
+                f"{_fmt_value(role_total[role])}")
+            rollups += 1
+        # fleet-global block store residency (satellite of ROADMAP item
+        # 3: the byte budget publish-backpressure will gate on)
+        store_bytes = self._store_bytes()
+        if store_bytes is not None:
+            resident, evicted = store_bytes
+            header("fleet_kv_store_resident_bytes", "gauge",
+                   "Resident (fetchable) bytes in the fleet-global KV "
+                   "block store, folded from its journal")
+            lines.append(f"fleet_kv_store_resident_bytes {resident}")
+            header("fleet_kv_store_evicted_bytes", "gauge",
+                   "Bytes the store's LRU sweeper has evicted, folded "
+                   "from its journal")
+            lines.append(f"fleet_kv_store_evicted_bytes {evicted}")
+            rollups += 2
+        # summed gauges and counters
+        for src, dst in sorted(SUMMABLE_GAUGES.items()):
+            if src in gauge_sums:
+                header(dst, "gauge",
+                       f"Fleet-wide sum of per-host {src}")
+                lines.append(f"{dst} {_fmt_value(gauge_sums[src])}")
+                rollups += 1
+        for src in sorted(counter_sums):
+            dst = f"fleet_{src}"
+            header(dst, "counter",
+                   f"Fleet-wide sum of per-host {src}")
+            lines.append(f"{dst} {_fmt_value(counter_sums[src])}")
+            rollups += 1
+        # merged latency histograms + SLO attainment
+        for src, dst in sorted(MERGED_HISTOGRAMS.items()):
+            h = merged.get(src)
+            if h is None or not h.count:
+                continue
+            header(dst, "histogram",
+                   f"Cross-host merge of {src} (exact bucket sums; "
+                   f"shared bounds)")
+            for le in sorted(h.buckets):
+                le_lbl = {"le": "+Inf" if le == float("inf")
+                          else _fmt_value(le)}
+                lines.append(f"{dst}_bucket{_fmt_labels(le_lbl)} "
+                             f"{_fmt_value(h.buckets[le])}")
+            lines.append(f"{dst}_sum {_fmt_value(round(h.sum, 9))}")
+            lines.append(f"{dst}_count {_fmt_value(h.count)}")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(
+                    f"{dst}{_fmt_labels({'quantile': _fmt_value(q)})} "
+                    f"{_fmt_value(h.quantile(q))}")
+            rollups += 1
+        slo_pairs = [("ttft", self.slo_ttft,
+                      merged.get("ftl_serve_ttft_seconds")),
+                     ("tpot", self.slo_tpot,
+                      merged.get("ftl_serve_tpot_seconds"))]
+        for slo_name, bound, h in slo_pairs:
+            if bound <= 0 or h is None or not h.count:
+                continue
+            header("fleet_slo_attainment", "gauge",
+                   "Fraction of fleet requests meeting the --slo-*-ms "
+                   "bars, from the merged latency buckets")
+            lines.append(
+                f"fleet_slo_attainment{_fmt_labels({'slo': slo_name})} "
+                f"{_fmt_value(round(h.fraction_le(bound), 6))}")
+            rollups += 1
+        header("fleet_scrape_failures_total", "counter",
+               "Scrapes of live-leased hosts that failed (cumulative)")
+        lines.append(f"fleet_scrape_failures_total {self.scrape_failures}")
+        header("fleet_hosts_scraped", "gauge",
+               "Hosts successfully scraped this sweep")
+        lines.append(f"fleet_hosts_scraped {len(per_host)}")
+        rollups += 2
+
+        self.last = {"hosts": len(per_host), "series": series,
+                     "rollups": rollups, "stale": len(stale),
+                     "live": len(live), "t": now,
+                     "failures": self.scrape_failures}
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- CLI
+def get_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m fault_tolerant_llm_training_tpu.obs.federate",
+        description="Fleet /metrics federation aggregator: scrapes every "
+                    "live-leased host (ports discovered from lease "
+                    "values), re-exports per-host series with host= "
+                    "labels and serves fleet rollups on its own "
+                    "/metrics.")
+    p.add_argument("--store", required=True,
+                   help="fleet KV store root (the --store every fleet "
+                        "host and the router share)")
+    p.add_argument("--kv-store-dir", default=None,
+                   help="fleet-global KV block store root; enables the "
+                        "fleet_kv_store_resident/evicted_bytes rollups")
+    p.add_argument("--port", type=int, default=0,
+                   help="serve the federated /metrics here (0 = "
+                        "ephemeral; printed at startup)")
+    p.add_argument("--once", action="store_true",
+                   help="print one federated scrape to stdout (or "
+                        "--out) and exit — the ci_nightly drill mode")
+    p.add_argument("--out", default="",
+                   help="with --once: write the scrape here instead of "
+                        "stdout")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="server mode: seconds between logged sweeps")
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0)
+    p.add_argument("--slo-tpot-ms", type=float, default=0.0)
+    p.add_argument("--stale-factor", type=float, default=0.5,
+                   help="lease age > stale_factor*ttl counts as stale "
+                        "(wedged-but-alive) in fleet_hosts_stale")
+    p.add_argument("--scrape-timeout", type=float, default=2.0)
+    p.add_argument("--event-log", default="",
+                   help="flight-recorder JSONL for the federation audit "
+                        "events")
+    p.add_argument("--max-sweeps", type=int, default=0,
+                   help="server mode: exit after N sweeps (0 = forever)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    from ..utils.logging import (AUDIT_FLEETSCOPE_FEDERATE_FMT,
+                                 init_logger, logger)
+    args = get_args(argv)
+    init_logger()
+    if args.event_log:
+        events.configure(args.event_log, job="federate", host=0)
+    fed = Federator(args.store, kv_store_dir=args.kv_store_dir or None,
+                    slo_ttft_ms=args.slo_ttft_ms,
+                    slo_tpot_ms=args.slo_tpot_ms,
+                    stale_factor=args.stale_factor,
+                    timeout=args.scrape_timeout)
+
+    def audit_sweep():
+        events.emit_audit(
+            logger, AUDIT_FLEETSCOPE_FEDERATE_FMT.format(
+                hosts=int(fed.last.get("hosts", 0)),
+                series=int(fed.last.get("series", 0)),
+                rollups=int(fed.last.get("rollups", 0)),
+                stale=int(fed.last.get("stale", 0)),
+                failures=int(fed.last.get("failures", 0))),
+            "fleetscope_federate", hosts=int(fed.last.get("hosts", 0)),
+            series=int(fed.last.get("series", 0)),
+            rollups=int(fed.last.get("rollups", 0)),
+            stale=int(fed.last.get("stale", 0)),
+            failures=int(fed.last.get("failures", 0)))
+
+    if args.once:
+        text = fed.render()
+        audit_sweep()
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text)
+        else:
+            sys.stdout.write(text)
+        events.flush()
+        return 0
+
+    from .prometheus import MetricsServer
+    server = MetricsServer(registry=fed, port=args.port)
+    port = server.start()
+    logger.info(f"Federation | serving fleet /metrics on port {port} "
+                f"(store {args.store})")
+    sweeps = 0
+    try:
+        while True:
+            fed.render()  # refresh + audit even when nobody scrapes us
+            audit_sweep()
+            sweeps += 1
+            if args.max_sweeps and sweeps >= args.max_sweeps:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        events.flush()
+        server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

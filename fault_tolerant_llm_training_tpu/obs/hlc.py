@@ -1,0 +1,144 @@
+"""Hybrid logical clocks: causal cross-host ordering under clock skew.
+
+Every fleet artifact this repo folds — flight-recorder events, reqtrace
+spans, the request/store journals, heartbeat leases — is stamped with a
+wall-clock ``t`` by its writer. That is fine per host and wrong across
+hosts: a router whose clock runs 2 s ahead journals its fence *before*
+the SIGKILL it reacted to, and the post-mortem timeline reads backwards.
+An HLC (Kulkarni et al., "Logical Physical Clocks") fixes exactly this:
+each timestamp is a ``(wall_us, counter)`` pair where the wall component
+never moves backwards (a stepped-back OS clock just stops advancing it)
+and the counter breaks ties, so happened-before edges that the system
+actually observes — a record read is a message received — are preserved
+in timestamp order while staying within bounded skew of real time.
+
+Merges ride existing read paths, no new RPC: the lease registry merges
+the HLC carried in every lease value it sweeps, and the journal/store
+fold loops merge each record they read. A reader's next stamp therefore
+sorts after everything it has observed, on every host.
+
+Encoding: ``"{wall_us:016x}.{counter:08x}"`` — fixed-width hex, so the
+*string* sort order equals the numeric order and JSONL consumers (sort,
+awk, the timeline CLI) can order records without parsing. Readers must
+treat a missing/empty ``hlc`` field as "before all stamped records"
+(pre-upgrade journals remain foldable).
+
+Thread safety: one lock per clock; the module singleton is shared by
+every recorder in the process, which is what makes a process's own
+stamps totally ordered.
+"""
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+__all__ = ["HLC", "clock", "tick", "merge", "observe", "unpack", "pack",
+           "reset", "ZERO"]
+
+# Sorts before every real stamp; what readers substitute for a missing
+# ``hlc`` field on pre-upgrade records.
+ZERO = "0" * 16 + "." + "0" * 8
+
+
+def pack(wall_us: int, counter: int) -> str:
+    """Fixed-width hex encoding whose lexicographic order IS the HLC
+    order (wall first, counter tie-break)."""
+    return f"{wall_us:016x}.{counter:08x}"
+
+
+def unpack(stamp: Optional[str]) -> Tuple[int, int]:
+    """Decode a packed stamp; garbage or missing stamps decode to
+    (0, 0) — "before everything", never a crash (fold tolerance)."""
+    if not stamp or not isinstance(stamp, str):
+        return (0, 0)
+    try:
+        wall_hex, _, c_hex = stamp.partition(".")
+        return (int(wall_hex, 16), int(c_hex, 16)) if c_hex else (0, 0)
+    except ValueError:
+        return (0, 0)
+
+
+class HLC:
+    """One hybrid logical clock. ``physical`` is injectable (seconds,
+    ``time.time`` signature) so tests can step it backwards."""
+
+    def __init__(self, physical: Callable[[], float] = time.time):
+        self.physical = physical
+        self._wall_us = 0
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def _now_us(self) -> int:
+        return int(self.physical() * 1e6)
+
+    def tick(self) -> str:
+        """Stamp a local/send event. Monotonic even when the physical
+        clock steps backwards: the wall component only ratchets up, the
+        counter absorbs same-microsecond (or rewound-clock) bursts."""
+        pt = self._now_us()
+        with self._lock:
+            if pt > self._wall_us:
+                self._wall_us, self._counter = pt, 0
+            else:
+                self._counter += 1
+            return pack(self._wall_us, self._counter)
+
+    def merge(self, remote: Optional[str]) -> str:
+        """Stamp a receive event: advance past ``remote`` (a packed stamp
+        read off a lease value / journal record) AND local time. After
+        this, every local tick() sorts after the merged stamp."""
+        r_wall, r_counter = unpack(remote)
+        pt = self._now_us()
+        with self._lock:
+            if pt > self._wall_us and pt > r_wall:
+                self._wall_us, self._counter = pt, 0
+            elif self._wall_us == r_wall:
+                self._counter = max(self._counter, r_counter) + 1
+            elif self._wall_us > r_wall:
+                self._counter += 1
+            else:
+                self._wall_us, self._counter = r_wall, r_counter + 1
+            return pack(self._wall_us, self._counter)
+
+    def observe(self, remote: Optional[str]) -> None:
+        """Merge without minting a stamp (fold loops call this per
+        record; only the next actual event needs a fresh stamp)."""
+        r_wall, r_counter = unpack(remote)
+        with self._lock:
+            if (r_wall, r_counter) > (self._wall_us, self._counter):
+                self._wall_us, self._counter = r_wall, r_counter
+
+    def read(self) -> str:
+        """Current stamp without advancing (diagnostics only)."""
+        with self._lock:
+            return pack(self._wall_us, self._counter)
+
+
+# --------------------------------------------------------- module singleton
+# Shared by events.py, reqtrace.py, journal.py, kvstore.py and lease.py in
+# this process: one clock per process means a process's stamps are totally
+# ordered regardless of which recorder emitted them.
+_CLOCK = HLC()
+
+
+def clock() -> HLC:
+    return _CLOCK
+
+
+def tick() -> str:
+    return _CLOCK.tick()
+
+
+def merge(remote: Optional[str]) -> str:
+    return _CLOCK.merge(remote)
+
+
+def observe(remote: Optional[str]) -> None:
+    _CLOCK.observe(remote)
+
+
+def reset(physical: Callable[[], float] = time.time) -> None:
+    """Swap the process clock (tests only — injects a fake physical
+    clock and zeroes the logical state)."""
+    global _CLOCK
+    _CLOCK = HLC(physical)

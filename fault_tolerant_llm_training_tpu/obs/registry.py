@@ -36,8 +36,21 @@ def _label_key(labels: Dict[str, str]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (exposition format
+    0.0.4): backslash, double-quote and newline must be escaped or a
+    value like ``reason="bad \"token\""`` corrupts the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(key: _LabelKey, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{escape_label_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -166,7 +179,7 @@ class _Family:
             return list(self._children.items())
 
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help_text}",
+        lines = [f"# HELP {self.name} {escape_help(self.help_text)}",
                  f"# TYPE {self.name} {self.kind}"]
         for key, child in sorted(self.children()):
             if self.kind == "histogram":

@@ -79,6 +79,8 @@ import uuid
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
+from . import hlc
+
 # Spans that mark decode progress: used by the stitcher to find the last
 # token-committing event when a `done` span is missing (crashed host).
 _PROGRESS_SPANS = ("decode_round", "first_token", "prefill")
@@ -121,7 +123,8 @@ class SpanRecorder:
 
     def emit(self, trace_id: str, request_id: str, span: str,
              dur: Optional[float] = None, **payload) -> Dict:
-        rec = {"t": self.clock(), "trace_id": str(trace_id),
+        rec = {"t": self.clock(), "hlc": hlc.tick(),
+               "trace_id": str(trace_id),
                "id": str(request_id), "span": span, "job": self.job,
                "host": self.host}
         if dur is not None:

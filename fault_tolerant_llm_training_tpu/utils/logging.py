@@ -182,3 +182,27 @@ AUDIT_DISAGG_PLACE_FMT = ("[DISAGG] Placement {action} request {id} "
 # tests/test_audit_contract.py like the rest. ---
 AUDIT_KV_STORE_FMT = ("[KV STORE] {action} key {key} request {id}: "
                       "{blocks} block(s), {detail}")
+
+# --- Fleet-wide observability plane audit trail (obs/federate.py,
+# scripts/fleet_timeline.py, scripts/bench_trend.py) — the aggregation
+# layer's grep surface: each federation sweep (hosts scraped, series
+# re-exported, fleet rollups derived), each HLC-ordered timeline fold
+# with its anomaly count, and the bench-regression sentinel's verdict.
+# ci_nightly's federation drill and tests/test_fleetscope.py grep these,
+# frozen in tests/test_audit_contract.py like the rest. ---
+AUDIT_FLEETSCOPE_FEDERATE_FMT = ("[FLEETSCOPE] Federated {hosts} host(s): "
+                                 "{series} series, {rollups} fleet "
+                                 "rollup(s), {stale} stale, {failures} "
+                                 "scrape failure(s)")
+AUDIT_FLEETSCOPE_TIMELINE_FMT = ("[FLEETSCOPE] Timeline: {events} event(s) "
+                                 "from {hosts} host(s) in HLC order, "
+                                 "{anomalies} anomalie(s)")
+AUDIT_FLEETSCOPE_TREND_OK_FMT = ("[FLEETSCOPE] Bench trend: {metrics} "
+                                 "pinned metric(s) across {receipts} "
+                                 "receipt(s) within {tolerance_pct}% of "
+                                 "baseline")
+AUDIT_FLEETSCOPE_TREND_REGRESSION_FMT = ("[FLEETSCOPE] Bench trend "
+                                         "REGRESSION: {receipt} "
+                                         "{metric} {delta_pct:+.1f}% "
+                                         "({baseline} -> {current}, "
+                                         "{direction} is better)")

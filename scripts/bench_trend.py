@@ -1,0 +1,242 @@
+"""Bench-regression sentinel: hold every committed BENCH receipt to its
+own history.
+
+ci_nightly re-runs each bench and asserts its scenario-specific bars,
+but nothing watches the *committed receipts themselves* drift across
+PRs — a PR that re-commits BENCH_disagg_cpu.json with the interference
+ratio quietly down 15% passes every nightly bar that only checks
+"> 1x". This sentinel closes that gap: it parses every committed
+``BENCH_*.json``, maintains an append-only history
+(``logs/bench_trend.jsonl``), and fails (exit 3, metric named) when any
+pinned headline metric regresses more than ``--tolerance`` (default
+10%) against the best value the history has ever recorded.
+
+Only deliberately chosen headline metrics are pinned (the PINNED table
+below) with an explicit better-direction each — wall-clock magnitudes
+that ci_nightly already treats as machine-dependent are held to the
+committed receipt trend, not re-measured here.
+
+Usage:
+    python scripts/bench_trend.py                       # committed receipts
+    python scripts/bench_trend.py --current-dir /tmp/x  # compare a fresh /
+                                                        # synthetic set
+                                                        # against baseline
+    python scripts/bench_trend.py --json                # machine-readable
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fault_tolerant_llm_training_tpu.obs import events  # noqa: E402
+from fault_tolerant_llm_training_tpu.utils.logging import (  # noqa: E402
+    AUDIT_FLEETSCOPE_TREND_OK_FMT,
+    AUDIT_FLEETSCOPE_TREND_REGRESSION_FMT,
+    init_logger,
+    logger,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# receipt -> [(json key, better direction, label)]. One entry per
+# headline number a PR would be embarrassed to regress silently.
+PINNED: Dict[str, List[Tuple[str, str, str]]] = {
+    "BENCH_decode_tiny_cpu.json": [
+        ("value", "higher", "decode tokens/sec/slot")],
+    "BENCH_decode_paged_cpu.json": [
+        ("value", "higher", "long-context paged decode tokens/sec")],
+    "BENCH_decode_fused_cpu.json": [
+        ("value", "lower", "dispatches/token at burst 8")],
+    "BENCH_decode_prefix_cpu.json": [
+        ("value", "lower", "cached N8/N1 prefill ratio"),
+        ("kv_prefix_hit_rate_n8", "higher", "prefix-cache hit rate")],
+    "BENCH_decode_spec_cpu.json": [
+        ("value", "higher", "speculative decode speedup")],
+    "BENCH_decode_tree_cpu.json": [
+        ("value", "higher", "tree vs linear accepted/dispatch")],
+    "BENCH_prefill_packed_cpu.json": [
+        ("value", "higher", "packed prefill speedup vs sequential")],
+    "BENCH_serving_latency_cpu.json": [
+        ("value", "lower", "worst-point p99 TTFT ms")],
+    "BENCH_kv_spill_cpu.json": [
+        ("value", "higher", "spill-on late-request TTFT speedup")],
+    "BENCH_kv_quant_cpu.json": [
+        ("blocks_ratio", "higher", "int8 blocks at fixed pool bytes"),
+        ("concurrency_gain", "higher", "admission concurrency gain")],
+    "BENCH_disagg_cpu.json": [
+        ("value", "higher", "colocated/disagg p99 interference ratio")],
+    "BENCH_kv_store_cpu.json": [
+        ("cross_host_hit_rate", "higher", "fleet-store cross-host hit "
+                                          "rate")],
+}
+
+
+def read_pinned(receipts_dir: str) -> Dict[str, Dict[str, float]]:
+    """``{receipt: {metric: value}}`` for every pinned receipt present."""
+    out: Dict[str, Dict[str, float]] = {}
+    for receipt, metrics in sorted(PINNED.items()):
+        path = os.path.join(receipts_dir, receipt)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        got: Dict[str, float] = {}
+        for key, _direction, _label in metrics:
+            if key in data:
+                try:
+                    got[key] = float(data[key])
+                except (TypeError, ValueError):
+                    continue
+        if got:
+            out[receipt] = got
+    return out
+
+
+def load_history(path: str) -> List[Dict]:
+    entries: List[Dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail: keep the committed prefix
+    except OSError:
+        pass
+    return entries
+
+
+def baseline_from(history: List[Dict],
+                  committed: Dict[str, Dict[str, float]],
+                  receipt: str, key: str,
+                  direction: str) -> Optional[float]:
+    """Best value ever recorded for (receipt, key): the history's
+    best, seeded by the committed receipt when history is empty."""
+    values = [committed.get(receipt, {}).get(key)]
+    for entry in history:
+        values.append(entry.get("metrics", {}).get(receipt, {}).get(key))
+    values = [v for v in values if v is not None]
+    if not values:
+        return None
+    return max(values) if direction == "higher" else min(values)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--receipts-dir", default=REPO_ROOT,
+                   help="where the committed BENCH_*.json receipts live "
+                        "(the baseline; default: repo root)")
+    p.add_argument("--current-dir", default="",
+                   help="compare the receipts in this directory against "
+                        "the baseline instead of the committed ones "
+                        "(fresh bench output, or a synthetic-regression "
+                        "fixture); only receipts present here are "
+                        "checked, and history is NOT appended")
+    p.add_argument("--history",
+                   default=os.path.join(REPO_ROOT, "logs",
+                                        "bench_trend.jsonl"),
+                   help="append-only trend history (JSONL)")
+    p.add_argument("--tolerance", type=float, default=0.10,
+                   help="relative regression allowed in the worse "
+                        "direction before the sentinel fails")
+    p.add_argument("--no-history", action="store_true",
+                   help="do not append this run to the history file")
+    p.add_argument("--json", action="store_true",
+                   help="emit the per-metric verdicts as JSON")
+    p.add_argument("--event-log", default="",
+                   help="flight-recorder JSONL for the sentinel's audit "
+                        "event")
+    args = p.parse_args(argv)
+
+    init_logger()
+    if args.event_log:
+        events.configure(args.event_log, job="bench_trend", host=0)
+
+    committed = read_pinned(args.receipts_dir)
+    current = (read_pinned(args.current_dir) if args.current_dir
+               else committed)
+    history = load_history(args.history)
+
+    verdicts: List[Dict] = []
+    regressions: List[Dict] = []
+    for receipt in sorted(current):
+        for key, direction, label in PINNED[receipt]:
+            cur = current[receipt].get(key)
+            if cur is None:
+                continue
+            base = baseline_from(history, committed, receipt, key,
+                                 direction)
+            if base is None or base == 0:
+                continue
+            delta = (cur - base) / abs(base)
+            worse = -delta if direction == "higher" else delta
+            verdict = {"receipt": receipt, "metric": key, "label": label,
+                       "direction": direction, "baseline": base,
+                       "current": cur,
+                       "delta_pct": round(delta * 100.0, 3),
+                       "regressed": worse > args.tolerance}
+            verdicts.append(verdict)
+            if verdict["regressed"]:
+                regressions.append(verdict)
+
+    if not args.current_dir and not args.no_history and verdicts:
+        os.makedirs(os.path.dirname(os.path.abspath(args.history)),
+                    exist_ok=True)
+        with open(args.history, "a") as fh:
+            fh.write(json.dumps({"ts": time.time(),
+                                 "receipts_dir": args.receipts_dir,
+                                 "metrics": committed},
+                                separators=(",", ":")) + "\n")
+
+    if args.json:
+        print(json.dumps({"verdicts": verdicts,
+                          "regressions": len(regressions)}, indent=2))
+    else:
+        for v in verdicts:
+            mark = "REGRESSION" if v["regressed"] else "ok"
+            print(f"{mark}: {v['receipt']} {v['metric']} "
+                  f"({v['label']}) {v['current']} vs baseline "
+                  f"{v['baseline']} ({v['delta_pct']:+.1f}%, "
+                  f"{v['direction']} is better)")
+
+    if regressions:
+        worst = max(regressions,
+                    key=lambda v: (-v["delta_pct"]
+                                   if v["direction"] == "higher"
+                                   else v["delta_pct"]))
+        events.emit_audit(
+            logger, AUDIT_FLEETSCOPE_TREND_REGRESSION_FMT.format(
+                receipt=worst["receipt"], metric=worst["metric"],
+                delta_pct=worst["delta_pct"],
+                baseline=worst["baseline"], current=worst["current"],
+                direction=worst["direction"]),
+            "fleetscope_trend", regressed=len(regressions),
+            receipt=worst["receipt"], metric=worst["metric"],
+            delta_pct=worst["delta_pct"])
+        events.flush()
+        return 3
+    events.emit_audit(
+        logger, AUDIT_FLEETSCOPE_TREND_OK_FMT.format(
+            metrics=len(verdicts),
+            receipts=len({v["receipt"] for v in verdicts}),
+            tolerance_pct=int(round(args.tolerance * 100))),
+        "fleetscope_trend", regressed=0, metrics=len(verdicts),
+        receipts=len({v["receipt"] for v in verdicts}))
+    events.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,7 @@ from fault_tolerant_llm_training_tpu.obs.goodput import (  # noqa: E402
     stitch,
 )
 from fault_tolerant_llm_training_tpu.obs import reqtrace  # noqa: E402
+from scripts import fleet_timeline  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
@@ -318,6 +319,57 @@ class Result:
 
     def note(self, what: str):
         self.notes.append(f"note: {what}")
+
+
+def _write_postmortem(name: str, work: str) -> str:
+    """Fold a scenario's event/trace/journal trails into one HLC-ordered,
+    anomaly-annotated timeline (scripts/fleet_timeline.py) and write it
+    next to the scenario's workdir as ``postmortem_<name>.txt``. Returns
+    the timeline text ('' when the scenario left no trails)."""
+    base = os.path.join(work, name)
+    if not os.path.isdir(base):
+        return ""
+    files = fleet_timeline.collect([base])
+    entries = fleet_timeline.build_timeline(files)
+    if not entries:
+        return ""
+    text = fleet_timeline.format_timeline(entries)
+    out = os.path.join(work, f"postmortem_{name}.txt")
+    with open(out, "w") as fh:
+        fh.write(text)
+    print(f"   post-mortem timeline -> {out}")
+    return text
+
+
+def _check_fleet_postmortem(res: Result, timeline: str) -> None:
+    """The fleet drill's causal chain, read off the post-mortem: chaos
+    SIGKILLs h0, the router renders the fence verdict, then migrates —
+    in HLC order, spanning both hosts' trails plus the router's."""
+    if not res.check(bool(timeline),
+                     "post-mortem timeline generated from the scenario's "
+                     "event/trace/journal trails"):
+        return
+    lines = timeline.splitlines()
+
+    def first_idx(pred):
+        return next((i for i, ln in enumerate(lines) if pred(ln)), None)
+
+    kill = first_idx(lambda ln: "[CHAOS]" in ln and "host_kill" in ln)
+    fence = first_idx(lambda ln: "[FENCE]" in ln and "fleet_dead" in ln)
+    migrate = first_idx(lambda ln: "[MIGRATE]" in ln)
+    res.check(kill is not None and fence is not None
+              and migrate is not None,
+              "post-mortem annotates the chaos kill, the fence verdict "
+              "and the migration")
+    if None in (kill, fence, migrate):
+        return
+    res.check(kill < fence < migrate,
+              "SIGKILL -> fence -> migrate chain appears in HLC (causal) "
+              "order in the post-mortem timeline")
+    res.check("h0" in lines[kill],
+              "the annotated kill belongs to host h0's trail")
+    res.check("fleet_h1" in timeline or " h1 " in timeline,
+              "the timeline spans the surviving host's trail too")
 
 
 def _resume_rc_ok(res: Result, rc: int, out: str) -> bool:
@@ -1438,6 +1490,9 @@ def main(argv=None) -> int:
         else:
             res = run_scenario(name, work, parquet, args.seed,
                                baseline_losses, sbatch=args.sbatch)
+        timeline = _write_postmortem(name, work)
+        if name == "fleet":
+            _check_fleet_postmortem(res, timeline)
         results.append(res)
         print(f"   -> {'survived' if res.survived else 'FAILED'}")
 

@@ -96,7 +96,20 @@
 #    beat N independent caches (magnitude is machine-dependent; the
 #    direction is the bar), zero dropped requests, zero CRC rejects
 #    without chaos, and every store-fed stream bit-matches the
-#    store-less reference.
+#    store-less reference;
+# 12. fleet observability plane — (a) federation drill: two live
+#    /metrics servers behind heartbeat leases (ports discovered from
+#    the lease values, the real path), the aggregator's fleet rollups
+#    must bit-match the per-host sums (gauges, counters, every
+#    cumulative histogram bucket) with host=-labelled re-export and
+#    HELP/TYPE deduped, and the CLI --once mode must render the same
+#    scrape; (b) the chaos campaign's fleet post-mortem timeline
+#    (postmortem_fleet.txt) must exist and its SIGKILL -> fence ->
+#    migrate chain must appear in HLC (causal) order spanning both
+#    hosts; (c) bench-regression sentinel: scripts/bench_trend.py green
+#    over every committed BENCH_*.json, then demonstrably red (exit 3,
+#    metric named) on a synthetic fixture with one pinned headline
+#    metric degraded 12%.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -540,4 +553,154 @@ assert ok, "quantized decode parity check failed"
 print("ok: fused-dequant kernels within error bounds at D=64 and D=128")
 EOF
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store)"
+echo "== fleet metrics federation drill (2 hosts -> rollups == per-host sums)"
+FED_DIR="$WORK/feddrill"
+rm -rf "$FED_DIR"
+mkdir -p "$FED_DIR"
+python - "$FED_DIR" <<'EOF'
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+from fault_tolerant_llm_training_tpu.ft.lease import (FileKVStore,
+                                                      LeaseRegistry)
+from fault_tolerant_llm_training_tpu.obs import federate
+from fault_tolerant_llm_training_tpu.obs.federate import (
+    Federator, parse_metrics_text)
+from fault_tolerant_llm_training_tpu.obs.prometheus import MetricsServer
+from fault_tolerant_llm_training_tpu.obs.registry import MetricRegistry
+
+root = sys.argv[1]
+store = FileKVStore(root + "/store")
+specs = {"h0": (12.5, 128, [0.03, 0.08, 0.4]),
+         "h1": (30.0, 320, [0.06, 0.9])}
+servers, per_host = [], {}
+for host, (tps, tok, ttfts) in sorted(specs.items()):
+    reg = MetricRegistry()
+    reg.gauge("ftl_serve_tokens_per_sec", "decode throughput").set(tps)
+    reg.counter("ftl_serve_tokens_generated_total", "tokens").inc(tok)
+    hist = reg.histogram("ftl_serve_ttft_seconds", "ttft")
+    for v in ttfts:
+        hist.observe(v)
+    srv = MetricsServer(registry=reg, port=0)
+    port = srv.start()
+    servers.append(srv)
+    LeaseRegistry(store, host_id=host).renew(
+        slots_free=4, blocks_free=64, block_size=16, metrics_port=port)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+        per_host[host] = parse_metrics_text(
+            resp.read().decode("utf-8"))
+
+# the aggregator discovers the ports from the lease values and scrapes
+# the same endpoints over loopback — the real path, no injection
+fed = Federator(root + "/store", slo_ttft_ms=100.0)
+text = fed.render()
+with open(root + "/federated.txt", "w") as fh:
+    fh.write(text)
+meta, samples = parse_metrics_text(text)
+got = {}
+for name, labels, value in samples:
+    got.setdefault(name, []).append((labels, value))
+
+
+def host_sum(sample_name):
+    return sum(v for _m, ss in per_host.values()
+               for n, lb, v in ss if n == sample_name)
+
+
+assert got["fleet_hosts_live"][0][1] == 2
+assert got["fleet_hosts_scraped"][0][1] == 2
+assert got["fleet_scrape_failures_total"][0][1] == 0
+# bit-match: the rollups ARE the per-host sums, not approximations
+assert got["fleet_tokens_per_sec"][0][1] \
+    == host_sum("ftl_serve_tokens_per_sec") == 42.5
+assert got["fleet_ftl_serve_tokens_generated_total"][0][1] \
+    == host_sum("ftl_serve_tokens_generated_total") == 448
+assert got["fleet_ttft_seconds_count"][0][1] \
+    == host_sum("ftl_serve_ttft_seconds_count") == 5
+assert got["fleet_ttft_seconds_sum"][0][1] \
+    == round(host_sum("ftl_serve_ttft_seconds_sum"), 9)
+fleet_buckets = {lb["le"]: v
+                 for lb, v in got["fleet_ttft_seconds_bucket"]}
+for le, v in fleet_buckets.items():
+    per = sum(val for _m, ss in per_host.values()
+              for n, lb, val in ss
+              if n == "ftl_serve_ttft_seconds_bucket"
+              and lb["le"] == le)
+    assert v == per, f"bucket le={le}: fleet {v} != per-host sum {per}"
+# every per-host series is re-exported with a host= label
+hosts = {lb["host"] for lb, _v in got["ftl_serve_tokens_per_sec"]}
+assert hosts == {"h0", "h1"}
+# HELP/TYPE exactly once per family across both hosts
+for line in ("# TYPE ftl_serve_ttft_seconds histogram",
+             "# TYPE ftl_serve_tokens_per_sec gauge",
+             "# TYPE fleet_ttft_seconds histogram"):
+    assert text.count(line) == 1, line
+# 3 of 5 requests under the 100 ms SLO bar at bucket resolution
+slo = {lb["slo"]: v for lb, v in got["fleet_slo_attainment"]}
+assert slo["ttft"] == 0.6, slo
+# the CLI --once path renders the identical scrape (modulo lease age)
+rc = federate.main(["--store", root + "/store", "--once",
+                    "--out", root + "/federated_cli.txt"])
+assert rc == 0
+cli = open(root + "/federated_cli.txt").read()
+assert "fleet_tokens_per_sec 42.5" in cli
+assert "fleet_hosts_live 2" in cli
+for srv in servers:
+    srv.stop()
+print("ok: federation drill — fleet rollups bit-match the per-host "
+      "sums (tokens/s 42.5, counters 448, ttft count 5, every "
+      "cumulative bucket), host= re-export + deduped headers, "
+      "SLO attainment 0.6, CLI --once green")
+EOF
+
+echo "== chaos post-mortem timeline (fleet scenario, HLC causal order)"
+if ! test -s "$WORK/campaign/seed0/postmortem_fleet.txt"; then
+    echo "FAIL: campaign did not emit postmortem_fleet.txt"
+    exit 1
+fi
+for want in \
+    "ok: post-mortem timeline generated from the scenario's event/trace/journal trails" \
+    "ok: post-mortem annotates the chaos kill, the fence verdict and the migration" \
+    "ok: SIGKILL -> fence -> migrate chain appears in HLC (causal) order in the post-mortem timeline" \
+    "ok: the annotated kill belongs to host h0's trail" \
+    "ok: the timeline spans the surviving host's trail too"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: fleet post-mortem check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: fleet post-mortem (SIGKILL -> fence -> migrate in HLC order) checks present"
+
+echo "== bench-regression sentinel (committed receipts, then a synthetic regression)"
+python scripts/bench_trend.py --no-history
+# a 12% drop in a pinned higher-is-better headline metric must fail
+# with exit 3 and name the metric
+SENT_DIR="$WORK/bench_sentinel"
+rm -rf "$SENT_DIR"
+mkdir -p "$SENT_DIR"
+python - "$SENT_DIR" <<'EOF'
+import json
+import sys
+
+src = json.load(open("BENCH_disagg_cpu.json"))
+src["value"] = round(src["value"] * 0.88, 6)
+json.dump(src, open(sys.argv[1] + "/BENCH_disagg_cpu.json", "w"))
+EOF
+rc=0
+python scripts/bench_trend.py --no-history \
+    --current-dir "$SENT_DIR" > "$SENT_DIR/verdict.txt" || rc=$?
+if [ "$rc" -ne 3 ]; then
+    echo "FAIL: sentinel exited $rc on a 12% regression (want 3)"
+    exit 1
+fi
+if ! grep -q "REGRESSION: BENCH_disagg_cpu.json value" "$SENT_DIR/verdict.txt"; then
+    echo "FAIL: sentinel did not name the regressed metric"
+    cat "$SENT_DIR/verdict.txt"
+    exit 1
+fi
+echo "ok: bench sentinel green on committed receipts, red (exit 3, metric named) on the synthetic regression"
+
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store, federation drill, fleet post-mortem, bench sentinel)"

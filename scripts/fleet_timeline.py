@@ -1,0 +1,266 @@
+"""Fleet timeline: fold every host's observability trail into ONE
+HLC-ordered, anomaly-annotated timeline — the post-mortem view.
+
+A fleet incident leaves its evidence scattered: each host's flight
+recorder (``events_*.jsonl``), each process's span trail
+(``trace_*.jsonl``), the request journal (one file per writer), and the
+block-store journal. Reading them one host at a time with wall-clock
+ordering lies under clock skew — a router 2 s ahead appears to fence a
+host *before* the SIGKILL it reacted to. Every record is now stamped
+with a hybrid logical clock (obs/hlc.py), so this tool merges all
+trails and sorts by HLC: causal order, skew-proof. Records predating
+the HLC stamp fall back to their wall clock (sorted before stamped
+records at the same instant) and are flagged ``~`` in the output.
+
+Anomalies are annotated inline so the chain of an incident reads top to
+bottom: chaos injections, dead-host fence verdicts, migrations,
+requeues, CRC rejects (handoff / shipment / spill / store fetch /
+corrupt publish), and hot-reload swaps. ``scripts/chaos_campaign.py``
+emits one of these timelines per scenario as its post-mortem report.
+
+Usage:
+    python scripts/fleet_timeline.py <dir-or-file> [more paths...]
+    python scripts/fleet_timeline.py run/ --anomalies-only
+    python scripts/fleet_timeline.py run/ --json --out timeline.json
+
+See also (same trails, different folds):
+    scripts/latency_report.py  — per-request TTFT/TPOT critical paths
+    scripts/goodput_report.py  — restart-chain goodput %, MTTR, lost time
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fault_tolerant_llm_training_tpu.obs import events, hlc  # noqa: E402
+from fault_tolerant_llm_training_tpu.utils.logging import (  # noqa: E402
+    AUDIT_FLEETSCOPE_TIMELINE_FMT,
+    init_logger,
+    logger,
+)
+
+# source stream inferred from record shape (no filename contract needed)
+#   trace    — has "span" + "trace_id"          (obs/reqtrace.py)
+#   store    — has "w" + "key"                  (inference/kvstore.py)
+#   event    — has "kind" + "job"               (obs/events.py)
+#   journal  — has "kind" + "id"                (inference/journal.py)
+
+
+def classify(rec: Dict) -> Optional[str]:
+    if "span" in rec and "trace_id" in rec:
+        return "trace"
+    if "w" in rec and "key" in rec:
+        return "store"
+    if "kind" in rec and "job" in rec:
+        return "event"
+    if "kind" in rec and "id" in rec:
+        return "journal"
+    return None
+
+
+def annotate(stream: str, rec: Dict) -> Optional[str]:
+    """Anomaly tag for one record, or None for routine traffic."""
+    kind = str(rec.get("kind", rec.get("span", "")))
+    text = " ".join(str(rec.get(k, ""))
+                    for k in ("action", "reason", "detail", "fault"))
+    blob = f"{kind} {text}".lower()
+    if kind.startswith("chaos_") or rec.get("fault"):
+        return "CHAOS"
+    if kind in ("fleet_dead", "fenced") or (
+            kind == "fleet_leave" and rec.get("reason") == "fenced"):
+        return "FENCE"
+    if "migrate" in blob or kind == "migration":
+        return "MIGRATE"
+    if kind in ("fleet_requeue", "requeue") or stream == "journal" and \
+            kind == "requeue":
+        return "REQUEUE"
+    if "reject" in blob or "crc" in blob:
+        return "CRC-REJECT"
+    if "reload" in blob or kind == "weights_reload_rejected" or \
+            kind == "reload_pause":
+        return "RELOAD"
+    if kind in ("signal", "exit") and str(rec.get("reason", "")) not in (
+            "", "eos", "length", "drain", "done"):
+        return "EXIT"
+    return None
+
+
+def _who(stream: str, rec: Dict) -> str:
+    if stream == "store":
+        return str(rec.get("w", "?"))
+    if stream == "journal":
+        return str(rec.get("host", rec.get("w", "?")))
+    job = str(rec.get("job", ""))
+    host = str(rec.get("host", ""))
+    return job or host or "?"
+
+
+def _summary(stream: str, rec: Dict) -> str:
+    if stream == "trace":
+        bits = [rec.get("span", "?"), f"req={rec.get('id', '?')}"]
+        if rec.get("dur") is not None:
+            bits.append(f"dur={float(rec['dur']):.4f}s")
+    elif stream == "store":
+        bits = [rec.get("kind", "?"), f"key={str(rec.get('key', ''))[:12]}"]
+        if rec.get("blocks"):
+            bits.append(f"blocks={rec['blocks']}")
+    elif stream == "journal":
+        bits = [rec.get("kind", "?"), f"req={rec.get('id', '?')}",
+                f"gen={rec.get('gen', 0)}"]
+        if rec.get("committed") is not None:
+            bits.append(f"committed={len(rec['committed'])}")
+        if rec.get("tokens") is not None:
+            bits.append(f"tokens={len(rec['tokens'])}")
+    else:
+        bits = [rec.get("kind", "?")]
+        for k in ("step", "id", "reason", "fault", "action", "src", "dst",
+                  "replayed"):
+            if rec.get(k) not in (None, ""):
+                bits.append(f"{k}={rec[k]}")
+    return " ".join(str(b) for b in bits)
+
+
+def collect(paths: Iterable[str]) -> List[str]:
+    """Expand files / dirs / globs to the JSONL files to fold."""
+    files: List[str] = []
+    for raw in paths:
+        hits = glob.glob(raw)
+        for path in (hits if hits else [raw]):
+            if os.path.isdir(path):
+                for root, _dirs, names in os.walk(path):
+                    files.extend(os.path.join(root, n)
+                                 for n in sorted(names)
+                                 if n.endswith(".jsonl"))
+            elif os.path.isfile(path):
+                files.append(path)
+    return sorted(set(files))
+
+
+def build_timeline(files: Iterable[str]) -> List[Dict]:
+    """Read every record, stamp a sort key, classify + annotate.
+
+    Sort key: the record's HLC when present; otherwise one synthesized
+    from its wall clock (``pack(t_us, 0)``) so pre-HLC trails still
+    interleave sensibly — those entries carry ``stamped=False``."""
+    entries: List[Dict] = []
+    for path in files:
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a killed writer
+            if not isinstance(rec, dict):
+                continue
+            stream = classify(rec)
+            if stream is None:
+                continue
+            stamp = rec.get("hlc")
+            stamped = bool(stamp)
+            if not stamped:
+                stamp = hlc.pack(int(float(rec.get("t", 0.0)) * 1e6), 0)
+            entries.append({
+                "hlc": str(stamp), "stamped": stamped,
+                "t": float(rec.get("t", 0.0)), "stream": stream,
+                "who": _who(stream, rec),
+                "what": _summary(stream, rec),
+                "anomaly": annotate(stream, rec),
+                "file": os.path.basename(path), "rec": rec})
+    entries.sort(key=lambda e: (e["hlc"], e["t"], e["who"]))
+    return entries
+
+
+def format_timeline(entries: List[Dict], anomalies_only: bool = False,
+                    limit: int = 0) -> str:
+    shown = [e for e in entries
+             if not anomalies_only or e["anomaly"]]
+    if limit:
+        shown = shown[-limit:]
+    hosts = sorted({e["who"] for e in entries})
+    n_anom = sum(1 for e in entries if e["anomaly"])
+    out = [f"fleet timeline: {len(entries)} record(s) from "
+           f"{len(hosts)} participant(s) ({', '.join(hosts)}), "
+           f"{n_anom} anomalie(s), HLC order",
+           ""]
+    width = max((len(e["who"]) for e in shown), default=4)
+    for e in shown:
+        mark = "!" if e["anomaly"] else ("~" if not e["stamped"] else " ")
+        tag = f" [{e['anomaly']}]" if e["anomaly"] else ""
+        out.append(f"{e['hlc']} {mark} {e['who']:<{width}} "
+                   f"{e['stream']:<7} {e['what']}{tag}")
+    if anomalies_only and not shown:
+        out.append("(no anomalies)")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog="Related folds over the same trails:\n"
+               "  scripts/latency_report.py   per-request TTFT/TPOT "
+               "critical paths + SLO attainment\n"
+               "  scripts/goodput_report.py   restart-chain goodput %, "
+               "MTTR, lost time by failure class")
+    p.add_argument("paths", nargs="+",
+                   help="event/trace/journal JSONL files, directories, "
+                        "or globs")
+    p.add_argument("--out", default="",
+                   help="write the timeline here instead of stdout")
+    p.add_argument("--json", action="store_true",
+                   help="emit the timeline entries as JSON")
+    p.add_argument("--anomalies-only", action="store_true",
+                   help="show only annotated (anomalous) records")
+    p.add_argument("--limit", type=int, default=0,
+                   help="show only the last N records (0 = all)")
+    p.add_argument("--event-log", default="",
+                   help="flight-recorder JSONL for this fold's own audit "
+                        "event")
+    args = p.parse_args(argv)
+
+    init_logger()
+    if args.event_log:
+        events.configure(args.event_log, job="fleet_timeline", host=0)
+    files = collect(args.paths)
+    entries = build_timeline(files)
+    if not entries:
+        print(f"no records found under: {', '.join(args.paths)}",
+              file=sys.stderr)
+        return 1
+    hosts = {e["who"] for e in entries}
+    n_anom = sum(1 for e in entries if e["anomaly"])
+    events.emit_audit(
+        logger, AUDIT_FLEETSCOPE_TIMELINE_FMT.format(
+            events=len(entries), hosts=len(hosts), anomalies=n_anom),
+        "fleetscope_timeline", events=len(entries), hosts=len(hosts),
+        anomalies=n_anom)
+    if args.json:
+        text = json.dumps([{k: v for k, v in e.items() if k != "rec"}
+                           for e in entries], indent=2) + "\n"
+    else:
+        text = format_timeline(entries,
+                               anomalies_only=args.anomalies_only,
+                               limit=args.limit)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    events.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,20 @@ FROZEN = {
     "AUDIT_KV_STORE_FMT":
         "[KV STORE] {action} key {key} request {id}: {blocks} block(s), "
         "{detail}",
+    "AUDIT_FLEETSCOPE_FEDERATE_FMT":
+        "[FLEETSCOPE] Federated {hosts} host(s): {series} series, "
+        "{rollups} fleet rollup(s), {stale} stale, {failures} "
+        "scrape failure(s)",
+    "AUDIT_FLEETSCOPE_TIMELINE_FMT":
+        "[FLEETSCOPE] Timeline: {events} event(s) from {hosts} host(s) "
+        "in HLC order, {anomalies} anomalie(s)",
+    "AUDIT_FLEETSCOPE_TREND_OK_FMT":
+        "[FLEETSCOPE] Bench trend: {metrics} pinned metric(s) across "
+        "{receipts} receipt(s) within {tolerance_pct}% of baseline",
+    "AUDIT_FLEETSCOPE_TREND_REGRESSION_FMT":
+        "[FLEETSCOPE] Bench trend REGRESSION: {receipt} {metric} "
+        "{delta_pct:+.1f}% ({baseline} -> {current}, {direction} is "
+        "better)",
 }
 
 
