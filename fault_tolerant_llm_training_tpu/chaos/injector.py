@@ -33,6 +33,12 @@ exposes one hook per injection site:
   verify-before-import must reject it and the request must degrade to
   committed-prefix replay (or, for store fetches, local chunked
   prefill);
+- :meth:`on_mem_push` — the in-memory KV transport lane
+  (inference/transport.py ``MemTransport``), keyed by push ordinal:
+  ``mem_corrupt`` poisons the fabric-resident train's manifest METADATA
+  without refreshing its push-time digest, so the importer's mem-lane
+  verify must catch the disagreement and degrade that train to the fs
+  artifact (the on-disk copy is untouched);
 - :meth:`on_prefill_chunk` — the prefill-role scheduler's chunk-commit
   boundary, keyed by completed-chunk ordinal: ``prefill_kill`` SIGKILLs
   the prefill engine mid-prompt.
@@ -336,6 +342,29 @@ class ChaosInjector:
         return self._corrupt_artifact(
             "store_corrupt", artifact_dir, ordinal,
             what=f"store artifact {ordinal}")
+
+    def on_mem_push(self, fabric, handle: str,
+                    ordinal: int = 0) -> Optional[str]:
+        """In-memory transport push hook (inference/transport.py
+        ``MemTransport``, called AFTER a train's device arrays land in
+        the shared fabric, keyed by push ordinal): ``mem_corrupt``
+        poisons the fabric-resident manifest's metadata WITHOUT
+        refreshing the push-time digest — the mem-lane analogue of the
+        payload byte flips, except the damage is metadata because the
+        lane's whole verification contract IS the metadata digest. The
+        importer must catch the disagreement and degrade exactly this
+        train to the fs artifact. Returns the poisoned handle."""
+        poisoned = None
+        for e in self._pending(("mem_corrupt",), ordinal):
+            self._fire(e, at_step=ordinal, phase="poison")
+            detail = fabric.poison(handle)
+            if detail:
+                poisoned = str(handle)
+                events.emit(kind="chaos_mem_corrupt", step=int(ordinal),
+                            phase="poisoned", handle=str(handle),
+                            detail=detail)
+                events.flush()
+        return poisoned
 
     def on_spill(self, artifact_dir: str, ordinal: int = 0) -> Optional[str]:
         """Spill-tier hook (inference/scheduler.py), called AFTER a

@@ -86,6 +86,14 @@ store_corrupt   flip a payload byte in the Nth prefix train this host
                 spared) — a fetching host's verify-before-import must
                 CRC-reject exactly that train and degrade to local
                 chunked prefill with nothing lost
+mem_corrupt     poison the Nth block train pushed onto the in-memory KV
+                transport lane (inference/transport.py, keyed by push
+                ordinal): mutate the fabric-resident manifest METADATA
+                without refreshing its push-time digest — the importer's
+                mem-lane verify must catch the digest disagreement and
+                degrade that train to the fs artifact (and, if that is
+                also corrupt, to committed-prefix replay) with nothing
+                lost; the on-disk artifact is untouched
 ==============  ============================================================
 
 Steps are *global* training steps, so an entry in the past at resume time
@@ -117,6 +125,7 @@ FAULTS = {
     "prefill_kill": None,
     "ship_corrupt": None,
     "store_corrupt": None,
+    "mem_corrupt": None,
 }
 
 # The serving loop has no training steps, prefetcher or KV agreement: only
@@ -129,7 +138,7 @@ SERVE_FAULTS = ("sigusr1", "sigterm", "reload_signal", "spill_corrupt")
 # process with its own schedule, so @rank= is unnecessary there).
 FLEET_FAULTS = ("sigusr1", "sigterm", "host_kill", "heartbeat_delay",
                 "handoff_corrupt", "spill_corrupt", "prefill_kill",
-                "ship_corrupt", "store_corrupt")
+                "ship_corrupt", "store_corrupt", "mem_corrupt")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ENTRY_RE = re.compile(

@@ -1264,7 +1264,8 @@ class InferenceEngine:
         self.cache = cache
         return manifest
 
-    def import_pool_block_batch(self, parts) -> list:
+    def import_pool_block_batch(self, parts,
+                                allow_partial: bool = False) -> list:
         """Verify every artifact in ``parts`` ((art_dir, dest_blocks)
         pairs) and land them all in ONE scatter per pool array, WITHOUT
         touching any slot's fill count — the disaggregated decode
@@ -1276,7 +1277,8 @@ class InferenceEngine:
         in ``parts`` order."""
         if self.kv_layout != "paged":
             raise ValueError("block import requires the paged KV layout")
-        cache, manifests = import_block_batch(self.cache, parts)
+        cache, manifests = import_block_batch(
+            self.cache, parts, allow_partial=allow_partial)
         self.cache = cache
         return manifests
 

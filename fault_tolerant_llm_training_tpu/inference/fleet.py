@@ -59,6 +59,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 from ..chaos import FLEET_FAULTS, ChaosInjector, parse_schedule
@@ -74,6 +75,7 @@ from ..utils.logging import (
     AUDIT_FLEET_JOIN_FMT,
     AUDIT_FLEET_LEAVE_FMT,
     AUDIT_KV_QUANT_FMT,
+    AUDIT_KV_STORE_FMT,
     AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
     AUDIT_SERVE_DRAINING_FMT,
@@ -88,8 +90,9 @@ from .engine import (
 )
 from .journal import RequestJournal, persist_unserved
 from .kv_cache import bf16_block_bytes, block_bytes
-from .kvstore import BlockStore
+from .kvstore import BlockStore, run_sweeper
 from .scheduler import Request, Scheduler
+from .transport import make_transport, resolve_lane
 
 ROUTER_JOURNAL = "router.jsonl"
 
@@ -97,6 +100,11 @@ _M_ENGINE_ROLE = REGISTRY.gauge(
     "engine_role",
     "Disaggregated serving role as an info label "
     "(engine_role{engine_role=...} 1)")
+_M_KV_TRANSPORT = REGISTRY.gauge(
+    "kv_transport_lane",
+    "Resolved KV transport lane as an info label "
+    "(kv_transport_lane{lane=...} 1): the lane this process exports "
+    "block trains on after same-pod auto-detect")
 
 
 class _AssignmentFollower:
@@ -240,6 +248,25 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                         "published prefix before each local prefill; a "
                         "CRC reject or miss degrades to the ordinary "
                         "local chunked prefill")
+    p.add_argument("--kv-store-max-bytes", type=int, default=0,
+                   help="fleet-store byte budget: > 0 starts the in-"
+                        "process sweeper daemon (lease-elected leader "
+                        "LRU-evicts down to the budget) AND applies "
+                        "publish backpressure — publishers skip store "
+                        "publishes (kv_store_publish_skipped_total) "
+                        "while resident bytes exceed the budget; 0 = "
+                        "unbounded, no sweeper")
+    p.add_argument("--kv-store-sweep-interval", type=float, default=2.0,
+                   help="seconds between sweeper daemon rounds "
+                        "(--kv-store-max-bytes > 0)")
+    p.add_argument("--kv-transport", default="fs", choices=("fs", "mem"),
+                   help="requested KV block-train transport lane "
+                        "(inference/transport.py). Fleet peers are "
+                        "separate OS processes with no shared fabric, so "
+                        "'mem' auto-detects down to 'fs' here (with a "
+                        "log line); the in-process transport drills "
+                        "(decode_bench/chaos_campaign 'transport') are "
+                        "where the mem lane actually engages")
     p.add_argument("--role", default="both",
                    choices=("both", "prefill", "decode"),
                    help="disaggregated pipeline role: 'prefill' admits "
@@ -311,6 +338,18 @@ def main(argv=None) -> None:
                 slots=args.slots),
             "ready", step=engine.restored_step, slots=args.slots,
             model=args.model)
+        # Same-pod auto-detect: every consumer of a fleet host's exports
+        # (the router, survivors, its decode peer) is ANOTHER OS process,
+        # and the mem fabric is process-local — a requested mem lane
+        # degrades to fs here, by construction rather than by failure.
+        lane = resolve_lane(args.kv_transport, colocated=False)
+        if lane != args.kv_transport:
+            logger.info("KV transport: requested %s lane degraded to fs "
+                        "— fleet peers are separate processes with no "
+                        "shared fabric", args.kv_transport)
+        transport = make_transport(lane)
+        _M_KV_TRANSPORT.labels(lane=lane).set(1)
+
         def on_ship(req, art_dir, ordinal, seq, start, end, length):
             # Late-bound over `journal`/`gens` (created right below, before
             # the scheduler can run a prefill). Chaos first (ship_corrupt,
@@ -320,7 +359,20 @@ def main(argv=None) -> None:
                 chaos.on_ship(art_dir, ordinal)
             journal.ship(req.id, args.host_id, art_dir, seq, start, end,
                          length, gens.get(req.id, 0),
-                         trace_id=req.trace_id)
+                         trace_id=req.trace_id, lane=transport.name)
+
+        def pacing():
+            # Decode-fleet landing capacity read off the heartbeat
+            # leases: free blocks summed over live decode-capable peers.
+            # None (= never stall) when no decode peer is visible — a
+            # lone prefill host joining first must not deadlock its own
+            # admission on a fleet that has not assembled yet.
+            peers = [l for h, l in lease.leases().items()
+                     if h != args.host_id and l.live
+                     and l.role in ("decode", "both")]
+            if not peers:
+                return None
+            return sum(int(l.blocks_free) for l in peers)
 
         # writer IS the lease host id: the store journal's residency
         # evidence must key by the same names the router's capacity
@@ -345,7 +397,11 @@ def main(argv=None) -> None:
                                             else None),
                           kv_store=kv_store,
                           on_store_put=(chaos.on_store_put
-                                        if chaos is not None else None))
+                                        if chaos is not None else None),
+                          transport=transport,
+                          pacing=(pacing if args.role == "prefill"
+                                  else None),
+                          kv_store_max_bytes=args.kv_store_max_bytes)
     _M_ENGINE_ROLE.labels(engine_role=args.role).set(1)
 
     store = FileKVStore(args.store)
@@ -374,6 +430,39 @@ def main(argv=None) -> None:
         "fleet_join", host=args.host_id, slots=slots_free,
         blocks=blocks_free, ttl=lease.ttl)
     events.flush()
+
+    # Fleet-store sweeper daemon: a lease-holding background loop — the
+    # lexically-lowest LIVE host (kvstore.sweep_leader over the same
+    # heartbeat leases the router reads) LRU-evicts unreferenced trains
+    # down to the byte budget; every other host's loop stands down, and
+    # leadership follows lease liveness when hosts die or fence. The
+    # publish side of the same budget is the scheduler's backpressure
+    # skip (kv_store_publish_skipped_total).
+    sweeper = None
+    sweep_stop = threading.Event()
+    if kv_store is not None and args.kv_store_max_bytes > 0:
+        def _on_evict(evicted):
+            for key in evicted:
+                events.emit_audit(
+                    logger, AUDIT_KV_STORE_FMT.format(
+                        action="sweep", key=key[:12], id="-", blocks=0,
+                        detail="fleet LRU eviction (over byte budget)"),
+                    "kv_store", action="sweep", key=key,
+                    host=args.host_id)
+
+        sweeper = threading.Thread(
+            target=run_sweeper,
+            args=(kv_store, args.kv_store_max_bytes),
+            kwargs=dict(interval=args.kv_store_sweep_interval,
+                        stop=sweep_stop.is_set,
+                        leases=lease.leases, host_id=args.host_id,
+                        on_evict=_on_evict),
+            daemon=True, name=f"kvstore-sweeper-{args.host_id}")
+        sweeper.start()
+        logger.info("Fleet store sweeper | budget %d byte(s), interval "
+                    "%.1fs, leader by lease election",
+                    args.kv_store_max_bytes,
+                    args.kv_store_sweep_interval)
 
     gens = {}     # rid -> generation of my current/last assignment
     done_ids = set()
@@ -589,6 +678,11 @@ def main(argv=None) -> None:
             "latency", id=c.request_id, trace=c.trace_id,
             ttft=c.ttft_seconds, tpot=c.tpot_seconds,
             tokens=len(c.tokens), reason=c.reason)
+    if sweeper is not None:
+        # stop the sweep loop BEFORE the lease leaves: a leaving leader
+        # must not race its own liveness test mid-round
+        sweep_stop.set()
+        sweeper.join(timeout=5.0)
     events.emit_audit(
         logger, AUDIT_FLEET_LEAVE_FMT.format(
             host=args.host_id, reason=exit_reason),

@@ -169,18 +169,22 @@ class RequestJournal:
 
     def ship(self, request_id: str, host: str, artifact: str, seq: int,
              start_block: int, end_block: int, length: int, gen: int,
-             trace_id: str = "") -> None:
+             trace_id: str = "", lane: str = "fs") -> None:
         """One incremental block shipment: ``artifact`` holds this
         request's prompt blocks ``[start_block, end_block)``, exported at
         a prefill chunk commit with ``length`` tokens committed in the
         slot. Written AFTER the artifact manifest commits (same fsync
         ordering as ``handoff``), so a record always points at a complete
-        artifact."""
+        artifact. ``lane`` names the KV transport lane the exporter used
+        (inference/transport.py) — informational: the artifact path is
+        the handle on EVERY lane, and a cross-process consumer always has
+        the fs form."""
         self._append({"kind": "ship", "id": request_id, "host": host,
                       "artifact": str(artifact), "seq": int(seq),
                       "start_block": int(start_block),
                       "end_block": int(end_block), "length": int(length),
-                      "gen": int(gen), "trace_id": str(trace_id)})
+                      "gen": int(gen), "trace_id": str(trace_id),
+                      "lane": str(lane)})
 
     def prefill_done(self, request_id: str, host: str, committed: List[int],
                      gen: int, kv_dtype: str = "bf16",
@@ -212,7 +216,8 @@ class RequestJournal:
                            "seq": int(s["seq"]),
                            "start_block": int(s["start_block"]),
                            "end_block": int(s["end_block"]),
-                           "length": int(s["length"])}
+                           "length": int(s["length"]),
+                           "lane": str(s.get("lane", "fs") or "fs")}
                           for s in (shipments or [])],
                       "trace_id": str(trace_id)})
 
@@ -341,7 +346,8 @@ def fold(root: str) -> Dict[str, RequestState]:
                     "seq": int(rec.get("seq", 0)),
                     "start_block": int(rec.get("start_block", 0)),
                     "end_block": int(rec.get("end_block", 0)),
-                    "length": int(rec.get("length", 0))})
+                    "length": int(rec.get("length", 0)),
+                    "lane": str(rec.get("lane", "fs") or "fs")})
         if kind == "prefill_done" and gen >= st.prefill_gen:
             st.prefill_done = True
             st.prefill_gen = gen

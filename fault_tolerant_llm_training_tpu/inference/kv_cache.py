@@ -566,7 +566,8 @@ def verify_block_artifact(art_dir: str) -> Dict:
 
 
 def import_block_batch(cache: PagedKVCache,
-                       parts: Sequence[Tuple[str, Sequence[int]]]
+                       parts: Sequence[Tuple[str, Sequence[int]]],
+                       allow_partial: bool = False
                        ) -> Tuple[PagedKVCache, List[Dict]]:
     """Verify EVERY artifact in ``parts`` (``(art_dir, dest_blocks)``
     pairs, payload i of each artifact -> its ``dest_blocks[i]``) and land
@@ -580,6 +581,17 @@ def import_block_batch(cache: PagedKVCache,
     unmodified by the caller's contract. ``lengths`` is NOT touched here
     (the destination slot differs between spill-restore, handoff-import
     and shipment-import); callers set it from the manifests' ``length``.
+
+    Under ``allow_partial=True`` a part may name FEWER destination rows
+    than its artifact has blocks: payload files
+    ``0..len(dest_blocks)-1`` land and the tail is left on disk —
+    sub-train addressability, the store's partial prefix hit (a train
+    published at depth N serves any prompt sharing its first
+    ``len(dest_blocks)`` blocks; chain-hash keys make position
+    content-determined, so a prefix of the payload files IS a prefix of
+    the prompt). Verification still covers the WHOLE artifact. By
+    default a count mismatch in EITHER direction is a caller bug
+    (``ValueError``) — only the store's prefix-addressed fetch opts in.
     Returns ``(new_cache, manifests)`` in ``parts`` order."""
     live = _cache_geometry(cache)
     manifests: List[Dict] = []
@@ -591,7 +603,8 @@ def import_block_batch(cache: PagedKVCache,
             raise KVBlockIntegrityError(
                 f"block artifact geometry {geo} does not fit pool {live}")
         n = len(manifest["blocks"])
-        if len(dest_blocks) != n:
+        if (len(dest_blocks) > n
+                or (not allow_partial and len(dest_blocks) != n)):
             raise ValueError(
                 f"artifact has {n} block(s) but {len(dest_blocks)} "
                 f"destination row(s) given")
@@ -607,8 +620,8 @@ def import_block_batch(cache: PagedKVCache,
              np.empty((len(dests),) + seg["shape"], seg["dtype"])
              for seg in layout}
     row = 0
-    for (art_dir, _), manifest in zip(parts, manifests):
-        for j in range(len(manifest["blocks"])):
+    for (art_dir, dest_blocks), manifest in zip(parts, manifests):
+        for j in range(len(dest_blocks)):
             with open(os.path.join(art_dir, _block_file_name(j)),
                       "rb") as f:
                 payload = f.read()

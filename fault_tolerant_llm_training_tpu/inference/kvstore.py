@@ -44,7 +44,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 from ..obs import hlc
 from .kv_cache import BLOCK_MANIFEST_NAME, export_blocks
 
-__all__ = ["BlockStore", "StoreHit", "TrainState", "main"]
+__all__ = ["BlockStore", "StoreHit", "TrainState", "main",
+           "run_sweeper", "sweep_leader"]
 
 _TRAINS_DIR = "trains"
 _JOURNAL_DIR = "journal"
@@ -52,10 +53,19 @@ _JOURNAL_DIR = "journal"
 
 @dataclass(frozen=True)
 class StoreHit:
-    """One matching train: ``depth`` full blocks ending at chain ``key``."""
+    """One matching train: ``depth`` full blocks of the prompt covered by
+    train ``key``. ``blocks`` is the PUBLISHED train's total — when
+    ``depth < blocks`` this is a sub-train (partial) hit: the prompt is a
+    proper prefix of a longer published train, and the fetch imports only
+    the first ``depth`` payload blocks."""
     key: str
     depth: int
     art_dir: str
+    blocks: int = 0
+
+    @property
+    def partial(self) -> bool:
+        return 0 < self.blocks != self.depth
 
 
 @dataclass
@@ -71,6 +81,7 @@ class TrainState:
     refs: int = 0                  # open ref - unref (in-flight fetches)
     hosts: Set[str] = field(default_factory=set)  # residency evidence
     evicted: bool = False          # newest put-vs-evict record is evict
+    keys: List[str] = field(default_factory=list)  # full chain (hex)
 
 
 class BlockStore:
@@ -120,28 +131,77 @@ class BlockStore:
 
     # ------------------------------------------------------------ lookup
     def match(self, keys: Sequence[bytes]) -> Optional[StoreHit]:
-        """Deepest resident train matching the chain-hash ladder ``keys``
-        (``chain_hashes`` output, one hash per full block), or None."""
+        """Deepest train covering a prefix of the chain-hash ladder
+        ``keys`` (``chain_hashes`` output, one hash per full block), or
+        None. Terminal hits first — a train keyed by ``keys[i]`` covers
+        ``i+1`` blocks exactly. Failing that, SUB-TRAIN addressability:
+        a published train whose per-block chain (its manifest ``keys``)
+        starts with ``keys[:i+1]`` serves the prompt partially — chain
+        hashes make position content-determined (``keys[i]`` can only sit
+        at position ``i`` of any train), so matching one interior key at
+        its own position proves the whole leading run matches. The fetch
+        then imports only the covered prefix of the payload files."""
         for i in range(len(keys) - 1, -1, -1):
             key = keys[i].hex()
             if self.has(key):
                 return StoreHit(key=key, depth=i + 1,
-                                art_dir=self.train_dir(key))
+                                art_dir=self.train_dir(key),
+                                blocks=i + 1)
+        index = self.chain_index()
+        for i in range(len(keys) - 1, -1, -1):
+            hit = index.get(keys[i].hex())
+            if hit is None:
+                continue
+            terminal, pos, total = hit
+            if pos == i and self.has(terminal):
+                return StoreHit(key=terminal, depth=i + 1,
+                                art_dir=self.train_dir(terminal),
+                                blocks=total)
         return None
+
+    def chain_index(self) -> Dict[str, tuple]:
+        """Interior chain key (hex) -> ``(terminal_key, position,
+        train_blocks)`` across resident trains — the sub-train lookup
+        surface. Built from the journaled per-block chains (``put``
+        records publish their full ``keys`` list), falling back to the
+        train manifest's ``meta.keys`` for trains published before the
+        chain rode in the journal."""
+        index: Dict[str, tuple] = {}
+        for key, st in self.resident().items():
+            chain = st.keys or self._manifest_keys(key)
+            for pos, kh in enumerate(chain):
+                index.setdefault(kh, (key, pos, len(chain)))
+        return index
+
+    def _manifest_keys(self, key: str) -> List[str]:
+        try:
+            with open(os.path.join(self.train_dir(key),
+                                   BLOCK_MANIFEST_NAME)) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            return []
+        keys = manifest.get("meta", {}).get("keys", [])
+        return [str(k) for k in keys]
 
     # ------------------------------------------------------------ publish
     def publish(self, cache, keys: Sequence[bytes],
                 blocks: Sequence[int], *, length: int,
                 meta: Optional[Dict] = None,
-                on_put: Optional[Callable[[str, int], None]] = None
-                ) -> Optional[Dict]:
+                on_put: Optional[Callable[[str, int], None]] = None,
+                transport=None) -> Optional[Dict]:
         """Export pool rows ``blocks`` (the train's full prefix blocks, in
         order) as the train keyed by ``keys[-1]``. Dedup: an already-
         visible key publishes nothing and returns None. ``on_put`` is the
         chaos hook (``store_corrupt``, keyed by this handle's publish
         ordinal), called after the artifact commits and BEFORE the journal
-        record — the same ordering the fleet's ship hook uses. Returns the
-        manifest, or None when deduped."""
+        record — the same ordering the fleet's ship hook uses.
+        ``transport`` routes the export through a KV transport lane
+        (inference/transport.py) — the mem lane additionally pushes the
+        train's device arrays so a same-process fetch lands without
+        touching the artifact bytes; None is the plain fs export. The
+        journal ``put`` record carries the train's full per-block chain,
+        the sub-train lookup's index source. Returns the manifest, or
+        None when deduped."""
         if len(blocks) != len(keys) or not keys:
             raise ValueError(
                 f"train needs one key per block: {len(keys)} key(s) for "
@@ -154,10 +214,11 @@ class BlockStore:
             # torn remains of a killed publisher: no manifest, so the key
             # was never visible — finish the death, then re-export
             shutil.rmtree(art_dir)
-        manifest = export_blocks(
+        chain = [k.hex() for k in keys]
+        export = export_blocks if transport is None else transport.export
+        manifest = export(
             cache, list(blocks), art_dir, length=int(length),
-            meta=dict(meta or {}, kind="store", key=key,
-                      keys=[k.hex() for k in keys]))
+            meta=dict(meta or {}, kind="store", key=key, keys=chain))
         nbytes = sum(int(f["size"]) for f in manifest["files"].values())
         ordinal = self.puts
         self.puts += 1
@@ -165,7 +226,7 @@ class BlockStore:
             on_put(art_dir, ordinal)
         self._append({"kind": "put", "key": key, "blocks": len(blocks),
                       "bytes": nbytes, "length": int(length),
-                      "host": self.writer})
+                      "host": self.writer, "keys": chain})
         return manifest
 
     # ------------------------------------------------------------ refcounts
@@ -248,6 +309,7 @@ class BlockStore:
                 st.last_use = max(st.last_use, t)
                 st.hosts.add(st.host)
                 st.evicted = False  # re-publish after evict resurrects
+                st.keys = [str(k) for k in rec.get("keys", []) or []]
             elif kind == "touch":
                 st.last_use = max(st.last_use, t)
                 if rec.get("host"):
@@ -316,6 +378,47 @@ class BlockStore:
         return evicted
 
 
+# ------------------------------------------------------------ sweeper loop
+def sweep_leader(leases: Dict[str, object], host_id: str) -> bool:
+    """Deterministic fleet sweeper election over the live heartbeat
+    leases: the lexically-lowest LIVE host id sweeps, everyone else
+    stands down. No extra coordination state — leadership follows lease
+    liveness, so the death of the sweeping host hands the duty to the
+    next survivor on its next interval, and a fenced zombie (its lease
+    expired) stops sweeping by the same test that stops its journal
+    writes."""
+    live = sorted(h for h, lease in leases.items()
+                  if getattr(lease, "live", False))
+    return bool(live) and live[0] == host_id
+
+
+def run_sweeper(store: "BlockStore", max_bytes: int, *, interval: float,
+                stop: Callable[[], bool],
+                leases: Optional[Callable[[], Dict[str, object]]] = None,
+                host_id: str = "",
+                on_evict: Optional[Callable[[List[str]], None]] = None
+                ) -> int:
+    """The fleet-lifecycle sweep daemon: every ``interval`` seconds, if
+    this host is the sweep leader (or no lease surface is given — the
+    single-host store), fold the journal and LRU-evict down to
+    ``max_bytes``. Runs until ``stop()`` is truthy; the sleep is chopped
+    so a drain signal is honored within ~50 ms. ``on_evict`` receives
+    each round's evicted keys (the caller's audit seam). Returns the
+    total trains evicted."""
+    total = 0
+    while not stop():
+        if leases is None or sweep_leader(leases(), host_id):
+            evicted = store.sweep(max_bytes)
+            if evicted:
+                total += len(evicted)
+                if on_evict is not None:
+                    on_evict(evicted)
+        deadline = time.monotonic() + max(interval, 0.05)
+        while not stop() and time.monotonic() < deadline:
+            time.sleep(0.05)
+    return total
+
+
 def get_store_args(argv=None):
     p = argparse.ArgumentParser(
         description="Standalone store sweeper: fold the store journal and "
@@ -326,6 +429,13 @@ def get_store_args(argv=None):
                    help="resident-bytes budget to sweep down to")
     p.add_argument("--writer", default="sweeper",
                    help="journal writer name for evict records")
+    p.add_argument("--interval", type=float, default=0.0,
+                   help="seconds between sweep rounds: > 0 runs the "
+                        "daemon loop until signaled (the fleet wires this "
+                        "in-process instead, with lease-based leader "
+                        "election); 0 = one shot and exit")
+    p.add_argument("--max-run-seconds", type=float, default=0.0,
+                   help="daemon mode safety timeout (0 = until signaled)")
     return p.parse_args(argv)
 
 
@@ -333,6 +443,24 @@ def main(argv=None) -> int:
     args = get_store_args(argv)
     store = BlockStore(args.store_dir, args.writer)
     before = store.resident_bytes()
+    if args.interval > 0:
+        from ..ft.signals import SignalFlag
+        flag = SignalFlag()
+        flag.register()
+        t0 = time.monotonic()
+
+        def stop():
+            return (flag.signum is not None
+                    or (args.max_run_seconds
+                        and time.monotonic() - t0 > args.max_run_seconds))
+
+        n = run_sweeper(store, args.max_bytes, interval=args.interval,
+                        stop=stop,
+                        on_evict=lambda keys: print(
+                            f"store sweep: {len(keys)} train(s) evicted"))
+        print(f"store sweep daemon: {before} -> {store.resident_bytes()} "
+              f"byte(s), {n} train(s) evicted")
+        return 0
     evicted = store.sweep(args.max_bytes)
     print(f"store sweep: {before} -> {store.resident_bytes()} byte(s), "
           f"{len(evicted)} train(s) evicted")

@@ -397,7 +397,12 @@ class Router:
         drops the whole list (returns []), because the decode admission
         needs contiguous coverage of the effective prompt — a hole means
         replaying anyway, and mixing verified blocks with a replay buys
-        nothing. Same retry/terminal split as :meth:`_verify_handoff`."""
+        nothing. Same retry/terminal split as :meth:`_verify_handoff`.
+        The router sits across a process boundary, so it always verifies
+        the fs form — the artifact path is the handle on every transport
+        lane, and the exporter's mem push (if any) is invisible here;
+        the journaled ``lane`` rides through for the decode host's own
+        ladder and the audit trail."""
         if st.ship_gen != st.prefill_gen or not st.shipments:
             return []
         ships = sorted(st.shipments, key=lambda s: int(s.get("seq", 0)))
@@ -420,15 +425,18 @@ class Router:
                     what=f"shipment artifact read {art}")
             except (KVBlockIntegrityError, RetryDeadlineExceeded) as e:
                 _M_SHIP_REJECTED.inc()
+                lane = str(s.get("lane", "fs") or "fs")
                 events.emit_audit(
                     logger, AUDIT_DISAGG_SHIP_FMT.format(
                         action="reject", id=st.request_id,
                         seq=int(s.get("seq", 0)), gen=st.gen + 1,
                         start=int(s.get("start_block", 0)),
-                        end=int(s.get("end_block", 0)), detail=str(e)),
+                        end=int(s.get("end_block", 0)),
+                        detail=f"lane {lane}: {e}"),
                     "disagg_ship", id=st.request_id,
                     seq=int(s.get("seq", 0)), gen=st.gen + 1,
-                    action="reject", artifact=art, detail=str(e))
+                    action="reject", artifact=art, lane=lane,
+                    detail=str(e))
                 return []
         return ships
 

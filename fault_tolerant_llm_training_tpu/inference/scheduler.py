@@ -123,6 +123,7 @@ from ..utils.logging import (
     AUDIT_KV_LEAK_FMT,
     AUDIT_KV_STORE_FMT,
     AUDIT_KV_TIER_FMT,
+    AUDIT_KV_XPORT_FMT,
 )
 from .kv_cache import (
     BLOCK_MANIFEST_NAME,
@@ -133,6 +134,7 @@ from .kv_cache import (
     verify_block_artifact,
 )
 from .prefix_cache import PrefixCache, chain_hashes
+from .transport import FsTransport
 
 logger = logging.getLogger()
 
@@ -363,7 +365,10 @@ class Scheduler:
                  on_ship: Optional[Callable] = None,
                  on_prefill_chunk: Optional[Callable[[int], None]] = None,
                  kv_store=None,
-                 on_store_put: Optional[Callable[[str, int], None]] = None):
+                 on_store_put: Optional[Callable[[str, int], None]] = None,
+                 transport=None,
+                 pacing: Optional[Callable[[], Optional[int]]] = None,
+                 kv_store_max_bytes: int = 0):
         self.engine = engine
         self.eos_token_id = eos_token_id
         self.clock = clock
@@ -459,6 +464,34 @@ class Scheduler:
         self.store_fetches = 0
         self.store_fetch_blocks = 0
         self.store_rejects = 0
+        # Pluggable KV transport (inference/transport.py): every block
+        # train this scheduler exports (shipments, store publishes) or
+        # imports (shipment admission, store fetches) moves through ONE
+        # transport object. FsTransport (default) is the existing
+        # filesystem artifact path verbatim; MemTransport adds the
+        # same-pod device-push lane with metadata-only verification and
+        # the mem -> fs -> replay fallback ladder.
+        self.transport = transport if transport is not None else FsTransport()
+        # Prefill-admission pacing (ROADMAP item 2's control plane): a
+        # prefill-role engine consults ``pacing()`` — the decode fleet's
+        # free-block count, derived from the heartbeat leases — before
+        # admitting a new prompt, and defers admission (queue intact)
+        # while the decode pool cannot land the blocks the prompt's
+        # shipments will carry. None (or a pacing() of None — no decode
+        # peers visible yet) never stalls: the ladder degrades to the
+        # unpaced behavior rather than deadlocking a booting fleet.
+        self.pacing = pacing
+        self.prefill_paced = 0
+        self._paced_logged: set = set()
+        # Publish backpressure (the sweeper daemon's other half): skip
+        # store publishes while the folded resident bytes exceed the
+        # byte budget, so publishers stop racing the LRU sweep. 0 = no
+        # budget (publish always).
+        self.kv_store_max_bytes = int(kv_store_max_bytes or 0)
+        self.store_publish_skipped = 0
+        self.store_partial_hits = 0
+        self.lane_fallbacks = 0
+        self.mem_lane_imports = 0
         if self.kv_store is not None and self.kv_layout != "paged":
             raise ValueError("the fleet KV store requires the paged KV "
                              "layout (trains are block artifacts)")
@@ -731,6 +764,31 @@ class Scheduler:
             "kv_store_publish_total",
             "Committed prefix trains published to the fleet store "
             "(deduped re-publishes of an identical chain hash excluded)")
+        self._m_xport_bytes = r.counter(
+            "kv_transport_bytes_total",
+            "KV block-train payload bytes moved through the pluggable "
+            "transport, by lane: fs counts artifact writes and "
+            "CRC-verified imports, mem counts device-to-device pushes "
+            "and metadata-verified landings")
+        self._m_store_partial = r.counter(
+            "kv_store_partial_hits_total",
+            "Fleet-store fetches that landed a PREFIX of a longer "
+            "published train (sub-train addressability): only the "
+            "covered blocks import, the rest chunk-prefills locally")
+        self._m_store_skipped = r.counter(
+            "kv_store_publish_skipped_total",
+            "Store publishes skipped under byte-budget backpressure "
+            "(folded resident bytes over --kv-store-max-bytes; the "
+            "sweeper daemon owns getting back under)")
+        self._m_paced = r.counter(
+            "prefill_paced_total",
+            "Prefill admissions deferred because the decode fleet's "
+            "free-block gauges (heartbeat leases) could not land the "
+            "prompt's shipments (ROADMAP item 2 pacing loop)")
+        self._m_lane_fallbacks = r.counter(
+            "kv_transport_lane_fallbacks_total",
+            "Block-train imports that degraded from the mem lane to the "
+            "fs artifact (fabric miss or metadata digest mismatch)")
         # Content-addressed prefix reuse: only engines that OPT IN get the
         # cache (InferenceEngine sets enable_prefix_cache in paged mode;
         # test doubles without the attribute keep plain allocation).
@@ -955,6 +1013,29 @@ class Scheduler:
                 # be overtaken indefinitely by fresh arrivals).
                 break
             req, submitted_at = self.queue[0]
+            if self.role == "prefill" and self.pacing is not None:
+                # Shipment pacing (ROADMAP item 2's control plane): every
+                # block this prompt prefills becomes a shipment the decode
+                # fleet must land, so admit only when the decode pool's
+                # free-block gauges (heartbeat leases, via pacing()) cover
+                # the need. Deferral keeps the queue intact — FIFO order
+                # and the submit contract are untouched, the head simply
+                # waits like it does for local pool shortage. pacing()
+                # returning None (no decode peers visible) never stalls.
+                decode_free = self.pacing()
+                if (decode_free is not None
+                        and decode_free < self._blocks_needed(req)):
+                    self.prefill_paced += 1
+                    self._m_paced.inc()
+                    if req.id not in self._paced_logged:
+                        # one audit line per request, not per retry round
+                        self._paced_logged.add(req.id)
+                        self._audit_xport(
+                            "pace", self.transport.name, req.id,
+                            self._blocks_needed(req),
+                            f"decode fleet has {decode_free} free "
+                            f"block(s), admission deferred")
+                    break
             art_entry = self._handoff_artifacts.get(req.id)
             if (art_entry is not None and self.kv_layout == "paged"
                     and not self.spec_k):
@@ -1617,6 +1698,13 @@ class Scheduler:
             action=action, id=rid, seq=seq, gen=gen, start=start, end=end,
             detail=detail), "disagg_ship")
 
+    def _audit_xport(self, action: str, lane: str, rid: str, blocks: int,
+                     detail: str) -> None:
+        events.emit_audit(logger, AUDIT_KV_XPORT_FMT.format(
+            action=action, lane=lane, id=rid, blocks=blocks,
+            detail=detail), "kv_xport", action=action, lane=lane, id=rid,
+            blocks=blocks)
+
     def _ship_commit(self, req: Request, slot_blocks: List[int],
                      eff: Sequence[int], pos: int) -> None:
         """Export the blocks the prefill just COMMITTED — full blocks up
@@ -1642,7 +1730,7 @@ class Scheduler:
             self._ship_root(),
             f"ship_{self.ship_exports:05d}_{req.id}_{seq:02d}")
         t0 = self.clock()
-        manifest = export_blocks(
+        manifest = self.transport.export(
             self.engine.cache, list(slot_blocks[start:end]), art_dir,
             length=length,
             meta={"kind": "ship", "request_id": req.id,
@@ -1656,8 +1744,15 @@ class Scheduler:
         st["seq"] = seq + 1
         self._m_ship_exports.inc()
         self._m_handoff_shipped.inc(end - start)
+        self._m_xport_bytes.labels(lane="fs").inc(nbytes)
         self._audit_ship("export", req.id, seq, st.get("gen", 0), start,
                          end, os.path.basename(art_dir))
+        if self.transport.name == "mem":
+            # the mem lane rides the same export: the device arrays are
+            # already in the fabric, addressed by the artifact path
+            self._m_xport_bytes.labels(lane="mem").inc(nbytes)
+            self._audit_xport("push", "mem", req.id, end - start,
+                              f"seq {seq}, {nbytes} byte(s)")
         self._trace(req, "block_ship", dur=dur, seq=seq,
                     blocks=end - start, bytes=nbytes, length=length)
         if self._on_ship is not None:
@@ -1702,30 +1797,51 @@ class Scheduler:
             self._ship_reject(req, gen, "shipments do not cover the "
                                         "committed prompt contiguously")
             return "fallback"
-        for s in ships:
-            art = str(s.get("artifact", ""))
-            try:
-                manifest = verify_block_artifact(art)
-            except (KVBlockIntegrityError, OSError) as e:
-                self._ship_reject(req, gen,
-                                  f"{os.path.basename(art)}: {e}")
-                return "fallback"
-            meta = manifest.get("meta", {})
-            s_start = int(s.get("start_block", -1))
-            s_end = int(s.get("end_block", -1))
-            if (meta.get("kind") != "ship"
-                    or str(meta.get("request_id")) != req.id
-                    or [int(t) for t in meta.get("prompt", [])] != eff
-                    or int(meta.get("seq", -1)) != int(s.get("seq", 0))
-                    or int(meta.get("start_block", -1)) != s_start
-                    or int(meta.get("end_block", -1)) != s_end
-                    or int(manifest.get("length", -1))
-                    != int(s.get("length", -1))
-                    or len(manifest.get("blocks", [])) != s_end - s_start):
-                self._ship_reject(
-                    req, gen, f"{os.path.basename(art)} disagrees with "
-                              f"the journal")
-                return "fallback"
+        # Lane ladder: try the transport's lanes in preference order (mem
+        # first when available, then the durable fs artifact). Each lane
+        # verifies EVERY shipment under its own contract — mem checks the
+        # push-time metadata digest, fs re-runs the CRC walk — before any
+        # device write; a non-final lane failing degrades the whole train,
+        # never a mixed import.
+        lane, fail_detail = None, ""
+        for cand in self.transport.lanes:
+            ok = True
+            for s in ships:
+                art = str(s.get("artifact", ""))
+                try:
+                    manifest = self.transport.verify(art, lane=cand)
+                except (KVBlockIntegrityError, OSError) as e:
+                    ok = False
+                    fail_detail = f"{os.path.basename(art)}: {e}"
+                    break
+                meta = manifest.get("meta", {})
+                s_start = int(s.get("start_block", -1))
+                s_end = int(s.get("end_block", -1))
+                if (meta.get("kind") != "ship"
+                        or str(meta.get("request_id")) != req.id
+                        or [int(t) for t in meta.get("prompt", [])] != eff
+                        or int(meta.get("seq", -1)) != int(s.get("seq", 0))
+                        or int(meta.get("start_block", -1)) != s_start
+                        or int(meta.get("end_block", -1)) != s_end
+                        or int(manifest.get("length", -1))
+                        != int(s.get("length", -1))
+                        or len(manifest.get("blocks", []))
+                        != s_end - s_start):
+                    ok = False
+                    fail_detail = (f"{os.path.basename(art)} disagrees "
+                                   f"with the journal")
+                    break
+            if ok:
+                lane = cand
+                break
+            if cand != self.transport.lanes[-1]:
+                self.lane_fallbacks += 1
+                self._m_lane_fallbacks.inc()
+                self._audit_xport("fallback", cand, req.id, len(ships),
+                                  fail_detail)
+        if lane is None:
+            self._ship_reject(req, gen, fail_detail)
+            return "fallback"
         # prefix-cache dedupe: shipments whose blocks are already resident
         # (a shared prompt another decode admitted) are skipped, not
         # re-imported — clamped DOWN to a shipment boundary because an
@@ -1772,7 +1888,21 @@ class Scheduler:
             if parts:
                 # the whole shipment train lands as ONE scatter per pool
                 # array — admission stall stays off the decode-round tail
-                self.engine.import_pool_block_batch(parts)
+                try:
+                    self.transport.import_batch(self.engine, parts,
+                                                lane=lane)
+                except KVBlockIntegrityError as e:
+                    if lane == "fs":
+                        raise
+                    # the mem landing failed between verify and scatter:
+                    # degrade this train to the durable fs artifacts
+                    self.lane_fallbacks += 1
+                    self._m_lane_fallbacks.inc()
+                    self._audit_xport("fallback", lane, req.id, imported,
+                                      str(e))
+                    lane = "fs"
+                    self.transport.import_batch(self.engine, parts,
+                                                lane="fs")
         except KVBlockIntegrityError as e:
             self.allocator.free(blocks)
             if hit is not None:
@@ -1804,6 +1934,11 @@ class Scheduler:
         self.ship_imports += 1
         self._m_ship_imports.inc(len(ships))
         self._m_handoff_shipped.inc(imported)
+        if lane == "mem":
+            self.mem_lane_imports += 1
+        self._audit_xport("land", lane, req.id, imported,
+                          f"{len(ships)} shipment(s), "
+                          f"{imp_dur * 1e3:.1f} ms")
         self._audit_ship("import", req.id, int(ships[-1].get("seq", 0)),
                          gen, n_use, n_ship_blocks,
                          f"{imported} imported, {n_use} deduped")
@@ -1872,12 +2007,34 @@ class Scheduler:
             return
         t0 = self.clock()
         try:
-            manifest = self.engine.import_pool_block_batch(
-                [(store_hit.art_dir, blocks)])[0]
+            # lane ladder: mem fabric first when the transport has it,
+            # the CRC-verified artifact as the terminal rung
+            manifest, lane = None, "fs"
+            for cand in self.transport.lanes:
+                try:
+                    manifest = self.transport.import_batch(
+                        self.engine, [(store_hit.art_dir, blocks)],
+                        lane=cand,
+                        allow_partial=store_hit.partial)[0]
+                    lane = cand
+                    break
+                except (KVBlockIntegrityError, OSError, ValueError) as e:
+                    if cand == self.transport.lanes[-1]:
+                        raise
+                    self.lane_fallbacks += 1
+                    self._m_lane_fallbacks.inc()
+                    self._audit_xport("fallback", cand, req.id, n, str(e))
             meta = manifest.get("meta", {})
+            mkeys = [str(k) for k in meta.get("keys", [])]
+            # a partial (sub-train) hit imports a PREFIX of a longer
+            # train: the manifest must hold at least n blocks and its
+            # per-block chain must agree with the prompt's at depth n
             if (meta.get("kind") != "store"
                     or str(meta.get("key", "")) != store_hit.key
-                    or len(manifest.get("blocks", [])) != n):
+                    or len(manifest.get("blocks", [])) < n
+                    or (store_hit.partial
+                        and (len(mkeys) < n
+                             or mkeys[n - 1] != keys[n - 1].hex()))):
                 raise KVBlockIntegrityError(
                     "store train manifest disagrees with its content "
                     "address")
@@ -1909,10 +2066,20 @@ class Scheduler:
         self._m_store_fetch_blocks.inc(n)
         self._m_store_hit_depth.observe(n)
         self._m_store_bytes.set(self.kv_store.resident_bytes())
-        self._audit_store("fetch", store_hit.key, req.id, n,
-                          f"depth {n}, {dur * 1e3:.1f} ms")
+        if lane == "mem":
+            self.mem_lane_imports += 1
+        if store_hit.partial:
+            self.store_partial_hits += 1
+            self._m_store_partial.inc()
+        self._audit_store(
+            "fetch", store_hit.key, req.id, n,
+            f"depth {n}"
+            + (f" of {store_hit.blocks} (partial)" if store_hit.partial
+               else "")
+            + f", lane {lane}, {dur * 1e3:.1f} ms")
         self._trace(req, "store_fetch", dur=dur, key=store_hit.key,
-                    depth=n, prompt_tokens=len(eff))
+                    depth=n, lane=lane, partial=store_hit.partial,
+                    prompt_tokens=len(eff))
 
     def _maybe_store_publish(self, req: Request, eff: Sequence[int],
                              slot_blocks: Sequence[int]) -> None:
@@ -1928,11 +2095,22 @@ class Scheduler:
         if not keys or self.kv_store.has(keys[-1].hex()):
             return
         n = len(keys)
+        if (self.kv_store_max_bytes
+                and self.kv_store.resident_bytes()
+                > self.kv_store_max_bytes):
+            # byte-budget backpressure: the sweeper daemon owns getting
+            # resident bytes back under budget; publishers just stop
+            # adding to the pile (and say so) until it does
+            self.store_publish_skipped += 1
+            self._m_store_skipped.inc()
+            self._audit_store("skip", keys[-1].hex(), req.id, n,
+                              "resident bytes over budget")
+            return
         t0 = self.clock()
         manifest = self.kv_store.publish(
             self.engine.cache, keys, list(slot_blocks[:n]),
             length=n * bs, meta={"request_id": req.id},
-            on_put=self._on_store_put)
+            on_put=self._on_store_put, transport=self.transport)
         if manifest is None:
             return
         dur = self.clock() - t0
@@ -2482,6 +2660,17 @@ class Scheduler:
             out["kv_store_fetches"] = self.store_fetches
             out["kv_store_fetch_blocks"] = self.store_fetch_blocks
             out["kv_store_rejects"] = self.store_rejects
+            out["kv_store_partial_hits"] = self.store_partial_hits
+            out["kv_store_publish_skipped"] = self.store_publish_skipped
+        out["kv_transport_lane"] = self.transport.name
+        out["kv_transport_bytes"] = dict(self.transport.lane_bytes)
+        out["kv_transport_land_seconds"] = dict(
+            self.transport.land_seconds)
+        if self.transport.name == "mem" or self.lane_fallbacks:
+            out["kv_transport_mem_imports"] = self.mem_lane_imports
+            out["kv_transport_lane_fallbacks"] = self.lane_fallbacks
+        if self.pacing is not None or self.prefill_paced:
+            out["prefill_paced"] = self.prefill_paced
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count

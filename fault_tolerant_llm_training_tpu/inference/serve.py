@@ -66,6 +66,7 @@ from .kv_cache import bf16_block_bytes, block_bytes
 from .kvstore import BlockStore
 from .sampler import AdaptiveK
 from .scheduler import Request, Scheduler
+from .transport import make_transport, resolve_lane
 
 _DEMO_PROMPT = "alpha bravo charlie delta echo"
 
@@ -81,6 +82,11 @@ _M_ENGINE_ROLE = REGISTRY.gauge(
     "Disaggregated serving role as an info label "
     "(engine_role{engine_role=...} 1); serve.py is always the colocated "
     "'both' — dedicated prefill/decode roles are fleet.py --role")
+_M_KV_TRANSPORT = REGISTRY.gauge(
+    "kv_transport_lane",
+    "Resolved KV transport lane as an info label "
+    "(kv_transport_lane{lane=...} 1): the lane this process exports "
+    "block trains on after same-pod auto-detect")
 
 
 class _RequestFollower:
@@ -219,6 +225,20 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "content-addressed artifacts and fetch the "
                         "deepest published prefix before each local "
                         "prefill; '' = store disabled")
+    p.add_argument("--kv-store-max-bytes", type=int, default=0,
+                   help="store publish byte budget: when the folded "
+                        "resident bytes exceed this, publishes are "
+                        "skipped (kv_store_publish_skipped_total) until "
+                        "a sweep gets back under; 0 = unbounded")
+    p.add_argument("--kv-transport", default="fs", choices=("fs", "mem"),
+                   help="KV block-train transport lane "
+                        "(inference/transport.py): 'fs' moves CRC-"
+                        "verified filesystem artifacts (the durable "
+                        "form); 'mem' additionally pushes trains device-"
+                        "to-device in-process and verifies manifest "
+                        "METADATA only, degrading to fs (then committed-"
+                        "prefix replay) on any mismatch. serve.py is one "
+                        "process, so 'mem' always applies here")
     p.add_argument("--paged-kernel", default="gather",
                    choices=("gather", "pallas"),
                    help="paged attention kernel (paged layout): 'gather' "
@@ -477,6 +497,14 @@ def main(argv=None) -> None:
         # prompt length.
         adaptive = (AdaptiveK(args.spec_k)
                     if args.spec_k and args.adaptive_spec_k else None)
+        # serve.py is one process: every import of its exports happens
+        # here, so a requested mem lane always resolves to mem
+        lane = resolve_lane(args.kv_transport, colocated=True)
+        transport = make_transport(lane)
+        _M_KV_TRANSPORT.labels(lane=lane).set(1)
+        if lane != "fs":
+            logger.info("KV transport: %s lane (fs artifacts remain the "
+                        "durable fallback)", lane)
         sched = Scheduler(engine,
                           eos_token_id=(None if args.no_eos
                                         else tokenizer.eos_token_id),
@@ -490,7 +518,9 @@ def main(argv=None) -> None:
                                     else None),
                           kv_store=(BlockStore(args.kv_store_dir,
                                                writer=f"serve_{os.getpid()}")
-                                    if args.kv_store_dir else None))
+                                    if args.kv_store_dir else None),
+                          transport=transport,
+                          kv_store_max_bytes=args.kv_store_max_bytes)
         prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
                    ) * args.repeat
         for i, text in enumerate(prompts):

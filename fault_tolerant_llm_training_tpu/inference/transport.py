@@ -1,0 +1,326 @@
+"""Pluggable KV transport: the lane KV block trains travel on.
+
+Every KV movement in the system — disaggregated prefill->decode
+shipments, fleet-store publish/fetch, migration handoff — speaks ONE
+contract: an artifact handle plus a manifest (geometry, block list,
+length, meta) whose verification gates any device write. This module
+puts a seam under that contract so the same scheduler/store/router code
+can move blocks over two very different fabrics:
+
+- ``FsTransport`` (lane ``fs``): the filesystem artifacts of
+  kv_cache.py, unchanged — byte payloads CRC-verified end to end, the
+  durable cross-host/cross-process form every committed receipt and
+  journal record names. The laptop transport, and the only one that
+  survives a process boundary.
+
+- ``MemTransport`` (lane ``mem``): a same-pod fast path. Export still
+  writes the fs artifact (it IS the durable record, the journal entry,
+  and the fallback lane), but additionally pushes the train's pool
+  slices device-to-device — ``jax.device_put`` of each
+  :func:`block_layout` segment's gathered rows, scale rows included for
+  int8 — into a process-local :class:`MemFabric` keyed by the SAME
+  artifact path. Import tries the fabric first: verification is on the
+  manifest *metadata* (a sha256 digest over geometry, block list,
+  length and meta — chain hashes ride in meta), never a re-CRC of
+  payload bytes, and landing is one device-side scatter per pool array
+  through the same index discipline as ``import_block_batch``. Any
+  miss or metadata mismatch degrades to the fs lane, whose CRC verify
+  can still reject down to the committed-prefix replay — the
+  mem -> fs -> replay ladder is structural, not a special case.
+
+Handles are identical across lanes (the artifact directory path), so
+ship/handoff/store journal records, router verification and receipts
+need no new addressing scheme. The fabric is process-local by design:
+one JAX process == one ICI domain here, which is exactly the DistServe
+"same pod" assumption — :func:`resolve_lane` is the auto-detect that
+degrades a cross-process fleet host's ``--kv-transport mem`` request
+back to ``fs``.
+"""
+
+import hashlib
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import (
+    KVBlockIntegrityError,
+    PagedKVCache,
+    QuantPool,
+    _cache_geometry,
+    block_layout,
+    export_blocks,
+    verify_block_artifact,
+)
+
+LANES = ("fs", "mem")
+
+
+def meta_digest(manifest: Dict) -> str:
+    """The mem lane's verification token: sha256 over the manifest's
+    METADATA — geometry, block list, length, meta (chain hashes, request
+    identity) — in canonical JSON. Deliberately excludes ``files``: the
+    whole point of the lane is that payload bytes pushed device-to-device
+    inside one pod are not re-hashed, their integrity is the fabric's."""
+    body = {k: manifest.get(k) for k in
+            ("version", "geometry", "blocks", "length", "meta")}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True,
+                   separators=(",", ":")).encode()).hexdigest()
+
+
+def _payload_bytes(manifest: Dict, n_blocks: int) -> int:
+    """Payload bytes ``n_blocks`` blocks of this train cost (every block
+    of one artifact is the same size by construction)."""
+    files = manifest.get("files", {})
+    if not files:
+        return 0
+    per = int(files[sorted(files)[0]].get("size", 0))
+    return per * int(n_blocks)
+
+
+class _MemTrain:
+    """One pushed train resident in the fabric: the manifest it was
+    exported under, its per-segment device arrays (block_layout order),
+    and the metadata digest captured at push time."""
+
+    __slots__ = ("manifest", "arrays", "digest")
+
+    def __init__(self, manifest: Dict, arrays: List, digest: str):
+        self.manifest = manifest
+        self.arrays = arrays
+        self.digest = digest
+
+
+class MemFabric:
+    """Process-local stand-in for the pod's ICI domain: artifact handle
+    -> pushed :class:`_MemTrain`. Exporter and importer must share ONE
+    fabric instance — there is no cross-process form, on purpose."""
+
+    def __init__(self):
+        self._trains: Dict[str, _MemTrain] = {}
+
+    def __len__(self) -> int:
+        return len(self._trains)
+
+    def __contains__(self, handle) -> bool:
+        return str(handle) in self._trains
+
+    def put(self, handle, train: _MemTrain) -> None:
+        self._trains[str(handle)] = train
+
+    def get(self, handle) -> Optional[_MemTrain]:
+        return self._trains.get(str(handle))
+
+    def drop(self, handle) -> None:
+        self._trains.pop(str(handle), None)
+
+    def poison(self, handle) -> str:
+        """Chaos hook (``mem_corrupt``): mutate a resident train's
+        manifest METADATA without refreshing its push-time digest — the
+        in-memory analogue of the artifact byte-flip faults. The mem
+        verify must catch the digest disagreement and degrade the import
+        to the fs lane. Returns a description of the mutation ('' when
+        the handle holds no train)."""
+        train = self._trains.get(str(handle))
+        if train is None:
+            return ""
+        train.manifest["length"] = int(train.manifest.get("length", 0)) + 1
+        return "manifest length incremented without re-digest"
+
+
+class FsTransport:
+    """The filesystem lane, verbatim: export/verify/import are the
+    kv_cache.py artifact functions, byte payloads CRC-verified before
+    any device write. ``lane_bytes`` / ``land_seconds`` feed the
+    ``kv_transport_bytes_total{lane=}`` counters and the transport
+    bench's shipment-landing clock."""
+
+    name = "fs"
+    lanes: Tuple[str, ...] = ("fs",)
+
+    def __init__(self):
+        self.lane_bytes: Dict[str, int] = {"fs": 0, "mem": 0}
+        self.land_seconds: Dict[str, float] = {"fs": 0.0, "mem": 0.0}
+
+    def export(self, cache: PagedKVCache, blocks: Sequence[int],
+               out_dir: str, *, length: int,
+               meta: Optional[Dict] = None) -> Dict:
+        manifest = export_blocks(cache, blocks, out_dir,
+                                 length=length, meta=meta)
+        self.lane_bytes["fs"] += _payload_bytes(manifest,
+                                                len(manifest["blocks"]))
+        return manifest
+
+    def verify(self, handle: str, lane: str = "fs") -> Dict:
+        if lane != "fs":
+            raise KVBlockIntegrityError(
+                f"transport {self.name!r} has no {lane!r} lane")
+        return verify_block_artifact(str(handle))
+
+    def import_batch(self, engine, parts: Sequence[Tuple[str, Sequence[int]]],
+                     lane: str = "fs",
+                     allow_partial: bool = False) -> List[Dict]:
+        if lane != "fs":
+            raise KVBlockIntegrityError(
+                f"transport {self.name!r} has no {lane!r} lane")
+        t0 = time.monotonic()
+        manifests = engine.import_pool_block_batch(
+            list(parts), allow_partial=allow_partial)
+        self.land_seconds["fs"] += time.monotonic() - t0
+        self.lane_bytes["fs"] += sum(
+            _payload_bytes(m, len(dest))
+            for m, (_, dest) in zip(manifests, parts))
+        return manifests
+
+
+def _land_mem_trains(cache: PagedKVCache,
+                     entries: Sequence[Tuple[_MemTrain, Sequence[int]]],
+                     allow_partial: bool = False) -> PagedKVCache:
+    """The mem lane's ``import_block_batch``: land every train with ONE
+    scatter per pool array, sources already on device. Geometry and
+    destination checks (vs the LIVE pool) precede the first write, same
+    contract as the fs path; under ``allow_partial=True`` a train may
+    land a prefix of its blocks (sub-train addressability)."""
+    live = _cache_geometry(cache)
+    dests: List[int] = []
+    for train, dest_blocks in entries:
+        geo = train.manifest.get("geometry")
+        if geo != live:
+            raise KVBlockIntegrityError(
+                f"mem train geometry {geo} does not fit pool {live}")
+        n = len(train.manifest.get("blocks", []))
+        if (len(dest_blocks) > n
+                or (not allow_partial and len(dest_blocks) != n)):
+            raise ValueError(
+                f"mem train has {n} block(s) but {len(dest_blocks)} "
+                f"destination row(s) given")
+        if 0 in dest_blocks:
+            raise ValueError("refusing to import into reserved null "
+                             "block 0")
+        dests.extend(int(b) for b in dest_blocks)
+    idx = jnp.asarray(np.asarray(dests, np.int32))
+    layout = block_layout(cache)
+    srcs = []
+    for si in range(len(layout)):
+        chunks = [train.arrays[si][:len(dest_blocks)]
+                  for train, dest_blocks in entries if len(dest_blocks)]
+        srcs.append(chunks[0] if len(chunks) == 1
+                    else jnp.concatenate(chunks, axis=0))
+    by_key = {(seg["layer"], seg["field"]): srcs[si]
+              for si, seg in enumerate(layout)}
+
+    def rebuild(pool, layer, field):
+        if isinstance(pool, QuantPool):
+            return QuantPool(
+                q=pool.q.at[idx].set(by_key[(layer, field)]),
+                scale=pool.scale.at[idx].set(
+                    by_key[(layer, field + "_scale")]))
+        return pool.at[idx].set(by_key[(layer, field)])
+
+    new_k = tuple(rebuild(cache.k[layer], layer, "k")
+                  for layer in range(len(cache.k)))
+    new_v = tuple(rebuild(cache.v[layer], layer, "v")
+                  for layer in range(len(cache.k)))
+    return cache.replace(k=new_k, v=new_v)
+
+
+class MemTransport(FsTransport):
+    """The same-pod push lane. Export piggybacks on the fs lane (the
+    artifact stays the durable record and the fallback), then pushes the
+    train's device arrays into the shared :class:`MemFabric` under the
+    artifact path. ``on_push(fabric, handle, ordinal)`` is the chaos
+    seam (``mem_corrupt``), keyed by push ordinal like the artifact
+    corruption faults."""
+
+    name = "mem"
+    lanes: Tuple[str, ...] = ("mem", "fs")
+
+    def __init__(self, fabric: Optional[MemFabric] = None,
+                 on_push: Optional[Callable[..., None]] = None):
+        super().__init__()
+        self.fabric = fabric if fabric is not None else MemFabric()
+        self.on_push = on_push
+        self.pushes = 0
+
+    def export(self, cache: PagedKVCache, blocks: Sequence[int],
+               out_dir: str, *, length: int,
+               meta: Optional[Dict] = None) -> Dict:
+        manifest = super().export(cache, blocks, out_dir,
+                                  length=length, meta=meta)
+        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        arrays = [jax.device_put(seg["array"][idx])
+                  for seg in block_layout(cache)]
+        # the fabric gets its OWN manifest copy: chaos poisons it, the
+        # on-disk artifact (the fallback lane) must stay pristine
+        self.fabric.put(out_dir, _MemTrain(
+            manifest=json.loads(json.dumps(manifest)), arrays=arrays,
+            digest=meta_digest(manifest)))
+        self.lane_bytes["mem"] += _payload_bytes(manifest,
+                                                 len(manifest["blocks"]))
+        ordinal, self.pushes = self.pushes, self.pushes + 1
+        if self.on_push is not None:
+            self.on_push(self.fabric, out_dir, ordinal)
+        return manifest
+
+    def verify(self, handle: str, lane: str = "fs") -> Dict:
+        if lane != "mem":
+            return verify_block_artifact(str(handle))
+        train = self.fabric.get(handle)
+        if train is None:
+            raise KVBlockIntegrityError(
+                f"mem lane: no pushed train for "
+                f"{os.path.basename(str(handle))}")
+        if meta_digest(train.manifest) != train.digest:
+            raise KVBlockIntegrityError(
+                f"mem lane: manifest metadata digest mismatch for "
+                f"{os.path.basename(str(handle))}")
+        return train.manifest
+
+    def import_batch(self, engine, parts: Sequence[Tuple[str, Sequence[int]]],
+                     lane: str = "fs",
+                     allow_partial: bool = False) -> List[Dict]:
+        if lane != "mem":
+            return super().import_batch(engine, parts, lane="fs",
+                                        allow_partial=allow_partial)
+        if getattr(engine, "kv_layout", "paged") != "paged":
+            raise ValueError("block import requires the paged KV layout")
+        t0 = time.monotonic()
+        entries, manifests = [], []
+        for handle, dest_blocks in parts:
+            manifest = self.verify(handle, lane="mem")
+            entries.append((self.fabric.get(handle), list(dest_blocks)))
+            manifests.append(manifest)
+        engine.cache = _land_mem_trains(engine.cache, entries,
+                                        allow_partial=allow_partial)
+        self.land_seconds["mem"] += time.monotonic() - t0
+        self.lane_bytes["mem"] += sum(
+            _payload_bytes(m, len(dest))
+            for m, (_, dest) in zip(manifests, entries))
+        return manifests
+
+
+def make_transport(lane: str, fabric: Optional[MemFabric] = None,
+                   on_push: Optional[Callable[..., None]] = None):
+    """Build the transport for a resolved lane name."""
+    if lane == "mem":
+        return MemTransport(fabric=fabric, on_push=on_push)
+    if lane == "fs":
+        return FsTransport()
+    raise ValueError(f"unknown kv transport lane {lane!r} "
+                     f"(expected one of {LANES})")
+
+
+def resolve_lane(requested: str, *, colocated: bool) -> str:
+    """Same-pod auto-detect. The mem lane needs exporter and importer on
+    one shared fabric (one process == one ICI domain here); a caller
+    whose peers live in OTHER processes — a fleet prefill/decode host —
+    degrades ``mem`` to ``fs``. ``colocated`` is the caller's claim that
+    every import of its exports happens in this process."""
+    if requested == "mem" and not colocated:
+        return "fs"
+    return requested

@@ -183,6 +183,17 @@ AUDIT_DISAGG_PLACE_FMT = ("[DISAGG] Placement {action} request {id} "
 AUDIT_KV_STORE_FMT = ("[KV STORE] {action} key {key} request {id}: "
                       "{blocks} block(s), {detail}")
 
+# --- KV transport audit trail (inference/transport.py via
+# inference/scheduler.py) — the pluggable block-train lane's grep
+# surface: mem-lane pushes riding each shipment/publish export, which
+# lane a train actually landed on, lane fallbacks (a mem metadata
+# mismatch degrading to the fs artifact, the fs CRC reject degrading to
+# replay), partial store hits, and paced prefill admissions. The
+# campaign's transport scenario and tests/test_transport.py grep these,
+# frozen in tests/test_audit_contract.py like the rest. ---
+AUDIT_KV_XPORT_FMT = ("[KV XPORT] {action} lane {lane} request {id}: "
+                      "{blocks} block(s), {detail}")
+
 # --- Fleet-wide observability plane audit trail (obs/federate.py,
 # scripts/fleet_timeline.py, scripts/bench_trend.py) — the aggregation
 # layer's grep surface: each federation sweep (hosts scraped, series

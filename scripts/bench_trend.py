@@ -74,6 +74,12 @@ PINNED: Dict[str, List[Tuple[str, str, str]]] = {
     "BENCH_kv_store_cpu.json": [
         ("cross_host_hit_rate", "higher", "fleet-store cross-host hit "
                                           "rate")],
+    "BENCH_kv_transport_cpu.json": [
+        ("mem_lane_landing_speedup", "higher", "mem-lane fs/mem "
+                                               "per-train landing "
+                                               "speedup"),
+        ("partial_hit_rate", "higher", "sub-train partial prefix hit "
+                                       "rate")],
 }
 
 

@@ -73,6 +73,20 @@ periodic checkpoints every 5 steps):
                leak-clean, and all four decode streams bit-match an
                unfailed colocated reference serve
 
+  transport    pluggable KV transport (inference/transport.py): an
+               in-process prefill/decode scheduler pair shares a
+               MemFabric; every exported train is pushed over the mem
+               lane, and chaos poisons the FIRST push's fabric manifest
+               metadata (mem_corrupt, push ordinal 0) while a payload
+               byte flip also corrupts the SAME request's fs artifact —
+               its whole ladder fails down to the committed-prefix
+               replay; a second request gets only the mem poison and
+               degrades one rung to the fs artifact. Every remaining
+               train lands on the mem lane, zero requests are lost, no
+               blocks leak, and all streams bit-match an unfailed
+               colocated reference — the full mem -> fs -> replay
+               degradation with nothing dropped at any rung
+
 Bit-exactness evidence: full-precision ``loss`` floats from the step
 events, compared against a clean baseline run with the same seed; for
 ckpt_corrupt, additionally the integrity manifest of the fallback step dir
@@ -108,7 +122,7 @@ from scripts import fleet_timeline  # noqa: E402
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIOS = ("sigusr1", "sigterm", "exception", "ckpt_corrupt",
              "loader_stall", "deploy", "fleet", "tiered", "disagg",
-             "kvstore")
+             "kvstore", "transport")
 # Known container-level post-restore native crash codes (SIGABRT/SIGSEGV,
 # as rc or negative signal): the resumed process dies after the restore
 # audits are flushed. Survival is then judged on the audit trail.
@@ -1404,6 +1418,182 @@ def run_kvstore_scenario(work: str, parquet: str, seed: int) -> Result:
     return res
 
 
+def run_transport_scenario(work: str, parquet: str, seed: int) -> Result:
+    """KV transport ladder scenario: chaos poisons the first mem-lane
+    push's fabric metadata (``mem_corrupt``) AND a payload byte of the
+    same request's fs artifact, so that request degrades mem -> fs ->
+    committed-prefix replay; a second request takes only the mem poison
+    and stops one rung down, on the fs artifact. Every other train lands
+    zero-copy on the mem lane. Zero requests lost, no leaked blocks, all
+    streams bit-identical to an unfailed colocated reference (module
+    docstring). Runs in-process: the mem lane's fabric is process-local
+    by design, so the two roles share one address space here just as
+    colocated prefill/decode engines on one host would."""
+    import glob as _glob
+    import logging as _logging
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.chaos.injector import (
+        ChaosInjector)
+    from fault_tolerant_llm_training_tpu.chaos.schedule import (
+        parse_schedule)
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.inference.transport import (
+        MemFabric, MemTransport)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+    from fault_tolerant_llm_training_tpu.obs.registry import MetricRegistry
+
+    res = Result("transport")
+    base = os.path.join(work, "transport")
+    os.makedirs(base, exist_ok=True)
+
+    cfg = get_config("tiny", vocab_size=64, seq_len=128,
+                     layer_impl="loop")
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+
+    def build():
+        return InferenceEngine(cfg, params, slots=2, max_len=128,
+                               prefill_buckets=(16, 32),
+                               kv_layout="paged", kv_block_size=8)
+
+    rng = np.random.default_rng(seed + 31)
+    reqs = [Request(id=f"req{i}",
+                    prompt=rng.integers(3, 64, size=24 + 8 * i).tolist(),
+                    max_new_tokens=12,
+                    **({} if i % 2 == 0 else
+                       {"temperature": 0.8, "top_p": 0.9}),
+                    seed=seed + 50 + i)
+            for i in range(4)]
+    n = len(reqs)
+
+    def clone(r, **extra):
+        return Request(id=r.id, prompt=list(r.prompt),
+                       max_new_tokens=r.max_new_tokens,
+                       temperature=r.temperature, top_p=r.top_p,
+                       seed=r.seed, **extra)
+
+    # unfailed colocated reference: the streams every degradation rung
+    # must reproduce bitwise
+    ref = Scheduler(build(), registry=MetricRegistry())
+    for r in reqs:
+        ref.submit(clone(r))
+    ref.run()
+    ref_streams = {c.request_id: c.tokens for c in ref.completed}
+    res.check(len(ref_streams) == n,
+              f"colocated reference served all {n} requests")
+
+    # capture the frozen [KV XPORT] audit trail the ladder must leave
+    audit, handler = [], None
+
+    class _Capture(_logging.Handler):
+        def emit(self, record):
+            audit.append(record.getMessage())
+
+    sched_logger = _logging.getLogger()    # the scheduler audits to root
+    handler = _Capture()
+    old_level = sched_logger.level
+    sched_logger.setLevel(_logging.INFO)   # audit lines log at INFO
+    sched_logger.addHandler(handler)
+    try:
+        fabric = MemFabric()
+        chaos = ChaosInjector(parse_schedule("step=0:mem_corrupt"),
+                              seed=seed)
+        poisoned = []
+
+        def on_push(fab, handle, ordinal=0):
+            hit = chaos.on_mem_push(fab, handle, ordinal)
+            if hit:
+                poisoned.append(hit)
+
+        ships = {}
+
+        def on_ship(req, art_dir, ordinal, seq, start, end, length):
+            ships.setdefault(req.id, []).append(
+                {"artifact": art_dir, "seq": seq, "start_block": start,
+                 "end_block": end, "length": length, "lane": "mem"})
+
+        pre = Scheduler(build(), role="prefill",
+                        ship_dir=os.path.join(base, "ships"),
+                        on_ship=on_ship,
+                        transport=MemTransport(fabric, on_push=on_push),
+                        registry=MetricRegistry())
+        for r in reqs:
+            pre.submit(clone(r))
+        pre.run()
+        first = {c.request_id: c.tokens for c in pre.completed}
+        res.check(len(first) == n and pre.ship_exports >= n,
+                  f"prefill committed and shipped all {n} requests "
+                  f"({pre.ship_exports} train(s) exported)")
+        res.check(len(poisoned) == 1,
+                  "chaos poisoned exactly the first mem push's fabric "
+                  "metadata (mem_corrupt, ordinal 0)")
+        res.check(len(fabric) == pre.ship_exports,
+                  "every exported train was pushed to the shared fabric")
+
+        # rung 3 setup: the poisoned train's request ALSO loses its fs
+        # artifact (one payload byte), so its ladder bottoms out at the
+        # committed-prefix replay; find which request owns that train
+        victim = next(r.id for r in reqs for s in ships[r.id]
+                      if s["artifact"] == poisoned[0])
+        # a second request takes ONLY the mem poison: one rung down
+        second = next(r.id for r in reqs if r.id != victim)
+        fabric.poison(ships[second][0]["artifact"])
+        blk = sorted(_glob.glob(os.path.join(
+            poisoned[0], "block_*.bin")))[0]
+        raw = bytearray(open(blk, "rb").read())
+        raw[3] ^= 0xFF
+        open(blk, "wb").write(bytes(raw))
+
+        dec = Scheduler(build(), role="decode",
+                        transport=MemTransport(fabric),
+                        registry=MetricRegistry())
+        for r in reqs:
+            dec.submit(clone(r, committed=tuple(first[r.id])),
+                       shipments=ships.get(r.id), ship_gen=0)
+        dec.run()
+        streams = {c.request_id: c.tokens for c in dec.completed}
+    finally:
+        sched_logger.removeHandler(handler)
+        sched_logger.setLevel(old_level)
+
+    res.check(len(streams) == n,
+              f"zero requests lost: decode completed {len(streams)}/{n} "
+              f"across all three degradation rungs")
+    res.check(streams == ref_streams,
+              "all decode streams — mem-landed, fs-degraded and "
+              "replayed alike — bit-identical to the unfailed colocated "
+              "reference")
+    res.check(dec.mem_lane_imports == n - 2,
+              f"untouched trains landed zero-copy on the mem lane "
+              f"({dec.mem_lane_imports} of {n})")
+    res.check(dec.lane_fallbacks == 2 and dec.ship_rejects == 1,
+              f"degradation ladder: two mem->fs fallbacks, one of which "
+              f"fell through to replay (fallbacks "
+              f"{dec.lane_fallbacks}, rejects {dec.ship_rejects})")
+    fallbacks = [ln for ln in audit
+                 if ln.startswith("[KV XPORT] fallback lane mem")]
+    res.check(len(fallbacks) == 2,
+              f"audit trail: [KV XPORT] fallback lane mem logged for "
+              f"both poisoned trains (got {len(fallbacks)})")
+    res.check(any(ln.startswith(f"[DISAGG] Shipment reject request "
+                                f"{victim} ") for ln in audit),
+              f"audit trail: shipment reject for the doubly-poisoned "
+              f"request {victim} (replay rung)")
+    res.check(pre.audit_block_leaks(strict=False) == []
+              and dec.audit_block_leaks(strict=False) == [],
+              "no leaked KV blocks on either role after the ladder")
+    return res
+
+
 def format_report(results, seed: int, wall: float, extra_notes) -> str:
     lines = []
     lines.append("Chaos survival campaign")
@@ -1487,6 +1677,8 @@ def main(argv=None) -> int:
             res = run_disagg_scenario(work, parquet, args.seed)
         elif name == "kvstore":
             res = run_kvstore_scenario(work, parquet, args.seed)
+        elif name == "transport":
+            res = run_transport_scenario(work, parquet, args.seed)
         else:
             res = run_scenario(name, work, parquet, args.seed,
                                baseline_losses, sbatch=args.sbatch)

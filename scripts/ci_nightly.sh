@@ -110,6 +110,20 @@
 #    over every committed BENCH_*.json, then demonstrably red (exit 3,
 #    metric named) on a synthetic fixture with one pinned headline
 #    metric degraded 12%.
+# 13. kv transport — (a) the campaign's transport drill (chaos poisons
+#    one mem-lane push's fabric metadata AND the same request's fs
+#    payload, a second push takes only the mem poison: the ladder must
+#    degrade mem -> fs -> committed-prefix replay with zero requests
+#    lost, the frozen [KV XPORT] fallback audits present, every other
+#    train landing zero-copy on the mem lane, and all streams
+#    bit-matching an unfailed colocated reference) is pinned
+#    line-for-line; (b) transport bench — re-runs the mem-vs-fs lane
+#    scenario and pins the BENCH_kv_transport_cpu.json bars: mem-lane
+#    per-train shipment landing beats the fs lane (> 1x; the magnitude
+#    is machine-dependent), the staggered-prefix store asks hit
+#    partially (rate > 0, deterministic and equal to the receipt), both
+#    lanes' streams and the partial-hit streams bit-exact, zero
+#    dropped, zero uninjected lane fallbacks.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -125,7 +139,7 @@ echo "== slow-marked suite"
 python -m pytest tests/ -q -m slow --continue-on-collection-errors \
     -p no:cacheprovider -p no:randomly
 
-echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered/disagg/kvstore drills)"
+echo "== chaos survival campaign (5 fault classes + deploy/fleet/tiered/disagg/kvstore/transport drills)"
 export FAKE_SLURM_DIR="$WORK/slurm"
 cat > "$WORK/requeue.sh" <<EOF
 #!/bin/bash
@@ -264,6 +278,30 @@ do
     fi
 done
 echo "ok: kvstore drill (publish -> poison -> affinity place -> CRC reject -> recompute) checks present"
+
+# the transport drill's substance: one pushed train lost BOTH its mem
+# metadata and its fs payload (ladder bottoms out at replay), a second
+# lost only its mem metadata (one rung down, onto the fs artifact),
+# the untouched trains landed zero-copy on the mem lane, the frozen
+# [KV XPORT] fallback audit fired for both poisoned trains, nothing
+# was lost or leaked, and every stream bit-matched an unfailed
+# colocated reference
+for want in \
+    "ok: chaos poisoned exactly the first mem push's fabric metadata (mem_corrupt, ordinal 0)" \
+    "ok: every exported train was pushed to the shared fabric" \
+    "ok: zero requests lost: decode completed 4/4 across all three degradation rungs" \
+    "ok: all decode streams — mem-landed, fs-degraded and replayed alike — bit-identical to the unfailed colocated reference" \
+    "ok: untouched trains landed zero-copy on the mem lane" \
+    "ok: degradation ladder: two mem->fs fallbacks, one of which fell through to replay (fallbacks 2, rejects 1)" \
+    "ok: audit trail: [KV XPORT] fallback lane mem logged for both poisoned trains (got 2)" \
+    "ok: no leaked KV blocks on either role after the ladder"
+do
+    if ! grep -qF "$want" "$WORK/chaos_campaign.txt"; then
+        echo "FAIL: transport drill check missing from report: $want"
+        exit 1
+    fi
+done
+echo "ok: transport drill (mem poison -> fs artifact -> committed-prefix replay, zero loss) checks present"
 
 echo "== shared_prefix bench vs committed receipt"
 python scripts/decode_bench.py --scenario shared_prefix \
@@ -540,6 +578,46 @@ print(f"ok: fleet store cross-host hit rate {rate} (> 0.5, matches "
       f"bit-exact")
 EOF
 
+echo "== kv transport bench vs committed receipt"
+python scripts/decode_bench.py --scenario transport \
+    --out "$WORK/bench_transport.json"
+python - "$WORK/bench_transport.json" BENCH_kv_transport_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+speedup = got["mem_lane_landing_speedup"]
+assert speedup > 1.0, (
+    f"mem lane bought nothing: fs/mem per-train landing ratio "
+    f"{speedup}x (zero-copy landing must beat re-reading artifacts)")
+assert got["bit_exact"], (
+    "transported streams diverged — a lane or the partial-hit path is "
+    "no longer bit-exact against its reference")
+assert got["dropped"] == 0, (
+    f"{got['dropped']} request(s) dropped across the lanes")
+assert got["lane_fallbacks"] == 0, (
+    f"{got['lane_fallbacks']} mem->fs fallback(s) without chaos — the "
+    f"metadata verify is rejecting clean trains")
+rate = got["partial_hit_rate"]
+assert rate > 0, (
+    f"partial hit rate {rate}: staggered prefix asks never landed as "
+    f"sub-train hits")
+assert rate == want["partial_hit_rate"], (
+    f"partial-hit rate is block-accounting-deterministic: got {rate}, "
+    f"receipt {want['partial_hit_rate']}")
+assert got["partial_hits"]["streams_bit_exact"], (
+    "partial-hit streams diverged from the storeless reference")
+assert want["mem_lane_landing_speedup"] > 1.0 and want["bit_exact"] \
+    and want["dropped"] == 0, "committed receipt is stale"
+print(f"ok: mem lane lands trains {speedup}x faster than the fs lane "
+      f"(fs {got['shipment_landing']['fs_ms_per_train']} ms -> mem "
+      f"{got['shipment_landing']['mem_ms_per_train']} ms per train), "
+      f"partial hit rate {rate} (matches receipt), "
+      f"{got['requests']} requests/lane, 0 dropped, 0 fallbacks, "
+      f"bit-exact")
+EOF
+
 echo "== fused-dequant parity check (int8 KV, D=64/128)"
 python - <<'EOF'
 import sys
@@ -703,4 +781,4 @@ if ! grep -q "REGRESSION: BENCH_disagg_cpu.json value" "$SENT_DIR/verdict.txt"; 
 fi
 echo "ok: bench sentinel green on committed receipts, red (exit 3, metric named) on the synthetic regression"
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store, federation drill, fleet post-mortem, bench sentinel)"
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store, kv transport, federation drill, fleet post-mortem, bench sentinel)"

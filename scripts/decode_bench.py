@@ -60,7 +60,7 @@ def main():
                             "shared_prefix", "fused_decode",
                             "mixed_prefill", "tree_spec", "serving_load",
                             "spill_preempt", "kv_quant", "disagg",
-                            "global_prefix"))
+                            "global_prefix", "transport"))
     p.add_argument("--burst-ns", default="1,4,8",
                    help="fused_decode scenario: comma-separated burst "
                         "lengths (tokens per dispatch) to sweep")
@@ -162,6 +162,8 @@ def main():
         result = _disagg(args, vocab)
     elif args.scenario == "global_prefix":
         result = _global_prefix(args, vocab)
+    elif args.scenario == "transport":
+        result = _transport(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -177,7 +179,8 @@ def main():
                     "spill_preempt": "BENCH_kv_spill",
                     "kv_quant": "BENCH_kv_quant",
                     "disagg": "BENCH_disagg",
-                    "global_prefix": "BENCH_kv_store"}.get(
+                    "global_prefix": "BENCH_kv_store",
+                    "transport": "BENCH_kv_transport"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -1930,6 +1933,246 @@ def _disagg(args, vocab):
             "decode_rounds_sampled": len(dis_best),
             "shipments_per_long_request": long_prompt // max(buckets),
         },
+    }
+
+
+def _transport(args, vocab):
+    """Mem-lane vs fs-lane KV transport at EQUAL capacity, plus the
+    sub-train (partial prefix) hit rate of the fleet store.
+
+    Part 1 — shipment landing. The same disaggregated prefill/decode
+    split (2+2 slots) serves the identical seeded workload twice: once
+    over the fs lane (artifact files re-read, CRC'd and device_put on
+    the decode host — what crossing hosts costs) and once over the mem
+    lane (the prefill host pushes the block train's device arrays into
+    the shared fabric at export; the decode host verifies manifest
+    METADATA — geometry, lengths, chain digest — and lands the whole
+    train in one scatter, never touching payload bytes). Landing
+    latency is the decode host's per-train import wall time,
+    ``transport.land_seconds[lane] / trains landed``, best of two
+    measured runs after a warmup. Both lanes must reproduce the
+    colocated reference streams BITWISE — the speedup is worthless if
+    the bytes aren't the same.
+
+    Part 2 — sub-train addressability. A publisher commits
+    staggered-length full trains to a fleet store; fetchers then ask
+    for proper PREFIXES of those trains. Every prefix ask must hit
+    PARTIALLY (import only the covered blocks, chunk-prefill the
+    rest), and the fetched streams must match storeless references.
+
+    Receipt bars (pinned by scripts/ci_nightly.sh and bench_trend):
+
+    - ``mem_lane_landing_speedup`` > 1.0 — fs over mem per-train
+      landing latency at fixed capacity;
+    - ``bit_exact`` — fs, mem and partial-hit streams all match their
+      references token for token;
+    - ``partial_hit_rate`` > 0 — staggered prefix asks land as
+      sub-train hits, not misses;
+    - ``dropped`` == 0.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.kvstore import BlockStore
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.inference.transport import (
+        MemFabric, MemTransport, make_transport)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+    from fault_tolerant_llm_training_tpu.obs.registry import MetricRegistry
+
+    cfg = get_config(args.model, vocab_size=vocab, seq_len=256,
+                     layer_impl=args.layer_impl)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    bs, buckets, max_len = 8, (16, 32, 64), 256
+    repeats = 2
+
+    def build(slots):
+        return InferenceEngine(cfg, params, slots=slots, max_len=max_len,
+                               prefill_buckets=buckets, kv_layout="paged",
+                               kv_block_size=bs)
+
+    colo = build(4)
+    pre_eng, dec_eng = build(2), build(2)
+
+    # staggered-length prompts: four lengths, mixed greedy/sampled, so
+    # trains of 2..12 blocks cross the lane under one fixed capacity
+    wrng = np.random.default_rng(args.seed + 9)
+    lengths = (16, 48, 64, 96)
+    requests = []
+    for i in range(8):
+        kw = ({} if i % 2 == 0 else {"temperature": 0.8, "top_p": 0.9})
+        requests.append(Request(
+            id=f"r{i}",
+            prompt=wrng.integers(
+                3, vocab, size=lengths[i % len(lengths)]).tolist(),
+            max_new_tokens=16, seed=300 + i, **kw))
+    n = len(requests)
+
+    def clone(r, **extra):
+        return Request(id=r.id, prompt=list(r.prompt),
+                       max_new_tokens=r.max_new_tokens,
+                       temperature=r.temperature, top_p=r.top_p,
+                       seed=r.seed, **extra)
+
+    def drive_colocated():
+        colo.reset()
+        sched = Scheduler(colo, eos_token_id=None,
+                          registry=MetricRegistry())
+        for r in requests:
+            sched.submit(clone(r))
+        sched.run()
+        return {c.request_id: c.tokens for c in sched.completed}
+
+    def drive_lane(lane, ship_dir):
+        """One full prefill -> decode pass over ``lane``; returns
+        (streams, per-train landing seconds, completed, fallbacks)."""
+        pre_eng.reset()
+        dec_eng.reset()
+        fabric = MemFabric() if lane == "mem" else None
+        ships = {}
+
+        def on_ship(req, art_dir, ordinal, seq, start, end, length):
+            ships.setdefault(req.id, []).append(
+                {"artifact": art_dir, "seq": seq, "start_block": start,
+                 "end_block": end, "length": length})
+
+        pre = Scheduler(pre_eng, eos_token_id=None, role="prefill",
+                        ship_dir=ship_dir, on_ship=on_ship,
+                        transport=make_transport(lane, fabric=fabric),
+                        registry=MetricRegistry())
+        dec = Scheduler(dec_eng, eos_token_id=None, role="decode",
+                        transport=make_transport(lane, fabric=fabric),
+                        registry=MetricRegistry())
+        for r in requests:
+            pre.submit(clone(r))
+        pre.run()
+        first = {c.request_id: c.tokens for c in pre.completed}
+        for r in requests:
+            dec.submit(clone(r, committed=tuple(first[r.id])),
+                       shipments=ships.get(r.id), ship_gen=0)
+        dec.run()
+        streams = {c.request_id: c.tokens for c in dec.completed}
+        landed = (dec.mem_lane_imports if lane == "mem"
+                  else len(dec.completed))
+        per_train = (dec.transport.land_seconds[lane] / landed
+                     if landed else float("inf"))
+        return streams, per_train, len(dec.completed), dec.lane_fallbacks
+
+    # warmup compiles prefill buckets, decode programs and both lanes'
+    # export/land paths
+    warm = tempfile.mkdtemp(prefix="xport_warm_")
+    try:
+        drive_colocated()
+        drive_lane("fs", os.path.join(warm, "fs"))
+        drive_lane("mem", os.path.join(warm, "mem"))
+    finally:
+        shutil.rmtree(warm, ignore_errors=True)
+
+    ref = drive_colocated()
+    lane_best = {"fs": float("inf"), "mem": float("inf")}
+    bit_exact, dropped, fallbacks = True, 0, 0
+    for _ in range(repeats):
+        root = tempfile.mkdtemp(prefix="xport_bench_")
+        try:
+            for lane in ("fs", "mem"):
+                streams, per_train, done, fb = drive_lane(
+                    lane, os.path.join(root, lane))
+                lane_best[lane] = min(lane_best[lane], per_train)
+                bit_exact = bit_exact and (streams == ref)
+                dropped += n - done
+                fallbacks += fb
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # Part 2: staggered prefix asks against published full trains
+    store_root = tempfile.mkdtemp(prefix="xport_store_")
+    prefixes = (40, 72)                 # 5 and 9 of the 12 blocks
+    full_len, fetches, partial, fetch_exact = 96, 0, 0, True
+    try:
+        fabric = MemFabric()
+        base = [wrng.integers(3, vocab, size=full_len).tolist()
+                for _ in range(2)]
+        pub = Scheduler(build(4), eos_token_id=None,
+                        kv_store=BlockStore(store_root, writer="pub"),
+                        transport=MemTransport(fabric),
+                        registry=MetricRegistry())
+        for i, p in enumerate(base):
+            pub.submit(Request(id=f"pub{i}", prompt=p, max_new_tokens=4,
+                               seed=400 + i))
+        pub.run()
+        asks = [Request(id=f"ask{i}_{j}", prompt=p[:cut],
+                        max_new_tokens=8, seed=500 + 10 * i + j)
+                for i, p in enumerate(base)
+                for j, cut in enumerate(prefixes)]
+        noref = Scheduler(build(4), eos_token_id=None,
+                          registry=MetricRegistry())
+        for r in asks:
+            noref.submit(clone(r))
+        noref.run()
+        want = {c.request_id: c.tokens for c in noref.completed}
+        fet = Scheduler(build(4), eos_token_id=None,
+                        kv_store=BlockStore(store_root, writer="fetch"),
+                        transport=MemTransport(fabric),
+                        registry=MetricRegistry())
+        for r in asks:
+            fet.submit(clone(r))
+        fet.run()
+        got = {c.request_id: c.tokens for c in fet.completed}
+        fetches, partial = fet.store_fetches, fet.store_partial_hits
+        fetch_exact = got == want
+        bit_exact = bit_exact and fetch_exact
+        dropped += len(asks) - len(fet.completed)
+    finally:
+        shutil.rmtree(store_root, ignore_errors=True)
+
+    speedup = lane_best["fs"] / lane_best["mem"]
+    return {
+        "bench": "kv_transport",
+        "scenario": "transport",
+        "model": args.model,
+        "backend": jax.default_backend(),
+        "metric": (f"fs / mem lane per-train shipment-landing latency on "
+                   f"the decode host at equal capacity ({args.model}, "
+                   f"vocab {vocab}, 2+2 slots, {n} staggered prompts "
+                   f"{'/'.join(str(x) for x in lengths)} tokens, block "
+                   f"size {bs}, best of {repeats}, backend "
+                   f"{jax.default_backend()})"),
+        "value": round(speedup, 3),
+        "unit": "x per-train landing latency, fs lane over mem lane",
+        "mem_lane_landing_speedup": round(speedup, 3),
+        "bit_exact": bool(bit_exact),
+        "dropped": int(dropped),
+        "lane_fallbacks": int(fallbacks),
+        "requests": n,
+        "kv_block_size": bs,
+        "prefill_buckets": list(buckets),
+        "shipment_landing": {
+            "fs_ms_per_train": round(lane_best["fs"] * 1000.0, 3),
+            "mem_ms_per_train": round(lane_best["mem"] * 1000.0, 3),
+            "trains_per_run": n,
+        },
+        "partial_hits": {
+            "store_fetches": int(fetches),
+            "partial_hits": int(partial),
+            "partial_hit_rate": round(partial / fetches, 3) if fetches
+            else 0.0,
+            "prefix_asks": len(prefixes) * 2,
+            "published_trains": 2,
+            "train_blocks": full_len // bs,
+            "streams_bit_exact": bool(fetch_exact),
+        },
+        "partial_hit_rate": round(partial / fetches, 3) if fetches
+        else 0.0,
     }
 
 

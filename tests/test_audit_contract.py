@@ -133,6 +133,9 @@ FROZEN = {
     "AUDIT_KV_STORE_FMT":
         "[KV STORE] {action} key {key} request {id}: {blocks} block(s), "
         "{detail}",
+    "AUDIT_KV_XPORT_FMT":
+        "[KV XPORT] {action} lane {lane} request {id}: {blocks} block(s), "
+        "{detail}",
     "AUDIT_FLEETSCOPE_FEDERATE_FMT":
         "[FLEETSCOPE] Federated {hosts} host(s): {series} series, "
         "{rollups} fleet rollup(s), {stale} stale, {failures} "
