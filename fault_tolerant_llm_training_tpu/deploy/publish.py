@@ -78,6 +78,12 @@ class Pointer:
     draft: Optional[dict] = None
     weights: Optional[dict] = None
     version: int = 1
+    # Tenant -> LoRA adapter sub-pointers (inference/adapters.py
+    # artifacts): adapter name -> {name, step, path, manifest_digest,
+    # rank, alpha}. Additive like ``weights`` — old pointers parse, old
+    # watchers ignore it; each entry is verified (digest + per-file CRC
+    # sweep) before any adapter pages load.
+    adapters: Optional[dict] = None
 
 
 def pointer_path(root: str) -> str:
@@ -123,7 +129,8 @@ def read_pointer_strict(root: str) -> Optional[Pointer]:
                    manifest_digest=str(data["manifest_digest"]),
                    draft=data.get("draft"),
                    weights=data.get("weights"),
-                   version=int(data.get("version", 1)))
+                   version=int(data.get("version", 1)),
+                   adapters=data.get("adapters"))
 
 
 def read_pointer(root: str) -> Optional[Pointer]:
@@ -175,6 +182,19 @@ def verify_pointer(root: str, ptr: Pointer) -> Tuple[bool, str]:
             return False, "malformed weights sub-pointer"
         if not ok:
             return False, f"weights {detail}"
+    if ptr.adapters is not None:
+        try:
+            entries = sorted(ptr.adapters.items())
+        except AttributeError:
+            return False, "malformed adapters sub-pointer"
+        for name, sub in entries:
+            try:
+                ok, detail = _verify_target(root, str(sub["path"]),
+                                            str(sub["manifest_digest"]))
+            except (KeyError, TypeError):
+                return False, f"malformed adapter sub-pointer ({name})"
+            if not ok:
+                return False, f"adapter {name} {detail}"
     return True, "ok"
 
 
@@ -322,6 +342,29 @@ def load_weights_artifact(root: str, weights: dict):
     return _unflatten_params(items)
 
 
+def adapter_pointer(root: str, name: str,
+                    art_dir: str) -> Optional[dict]:
+    """Build one tenant's adapter sub-pointer from an adapter artifact
+    directory (inference/adapters.py ``write_adapter_artifact`` layout:
+    factor .npy files + adapter.json + integrity.json). None if the
+    directory carries no manifest — such an artifact is not publishable."""
+    root = os.path.abspath(root)
+    art_dir = os.path.abspath(art_dir)
+    digest = manifest_digest(art_dir)
+    if digest is None:
+        return None
+    meta: dict = {}
+    meta_path = os.path.join(art_dir, "adapter.json")
+    if os.path.isfile(meta_path):
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+    return {"name": str(name), "step": int(meta.get("step", 0)),
+            "path": os.path.relpath(art_dir, root),
+            "manifest_digest": digest,
+            "rank": int(meta.get("rank", 0)),
+            "alpha": float(meta.get("alpha", 0.0))}
+
+
 class Publisher:
     """Atomically points serving at a verified checkpoint step.
 
@@ -342,12 +385,15 @@ class Publisher:
                             str(step))
 
     def publish(self, step: int, draft: Optional[dict] = None,
-                weights: Optional[dict] = None) -> Optional[Pointer]:
+                weights: Optional[dict] = None,
+                adapters: Optional[dict] = None) -> Optional[Pointer]:
         """Publish ``step`` (which must carry an integrity manifest);
         returns the committed pointer, or None if the step is not
         publishable. ``draft`` is an optional pre-built draft sub-pointer
         dict (see :func:`draft_pointer`); ``weights`` an optional
-        pre-built weights sub-entry (see :meth:`quantize_weights`)."""
+        pre-built weights sub-entry (see :meth:`quantize_weights`);
+        ``adapters`` an optional name -> sub-pointer map (see
+        :func:`adapter_pointer`)."""
         step_dir = self.step_dir(step)
         digest = manifest_digest(step_dir)
         if digest is None:
@@ -357,7 +403,8 @@ class Publisher:
             return None
         ptr = Pointer(step=int(step), job_id=self.job_id,
                       path=os.path.relpath(step_dir, self.root),
-                      manifest_digest=digest, draft=draft, weights=weights)
+                      manifest_digest=digest, draft=draft, weights=weights,
+                      adapters=adapters)
         write_pointer(self.root, ptr)
         _M_PUBLISHED.inc()
         _M_PUBLISHED_STEP.set(int(step))
@@ -365,7 +412,8 @@ class Publisher:
             logger,
             AUDIT_PUBLISH_FMT.format(step=int(step), digest=digest[:12]),
             "publish", step=int(step), digest=digest, path=ptr.path,
-            draft=bool(draft), weights=bool(weights))
+            draft=bool(draft), weights=bool(weights),
+            adapters=sorted(adapters) if adapters else [])
         events.flush()
         if self.chaos is not None:
             # post-commit corruption window: the pointer is live, the
@@ -444,6 +492,12 @@ def main(argv=None) -> int:
     p.add_argument("--layer-impl", default="loop",
                    help="layer_impl the checkpoint was trained with "
                         "(only used by --weights-dtype int8)")
+    p.add_argument("--adapter", action="append", default=[],
+                   metavar="NAME=DIR",
+                   help="attach a tenant LoRA adapter sub-pointer: NAME "
+                        "is the adapter id requests name, DIR the "
+                        "CRC-manifested adapter artifact directory "
+                        "(inference/adapters.py layout). Repeatable.")
     p.add_argument("--chaos", default="",
                    help="fault schedule keyed by the published step "
                         "(publish_corrupt only)")
@@ -492,7 +546,23 @@ def main(argv=None) -> int:
             logger.error("[DEPLOY] could not stage the quantized weights "
                          "artifact; not publishing")
             return 2
-    ptr = pub.publish(step, draft=draft, weights=weights)
+    adapters = None
+    if args.adapter:
+        adapters = {}
+        for spec in args.adapter:
+            name, _, art_dir = spec.partition("=")
+            if not name or not art_dir:
+                logger.error(f"[DEPLOY] malformed --adapter {spec!r} "
+                             f"(want NAME=DIR)")
+                return 2
+            sub = adapter_pointer(args.checkpoint_path, name, art_dir)
+            if sub is None:
+                logger.error(f"[DEPLOY] adapter artifact {art_dir} has no "
+                             f"integrity manifest; not publishing")
+                return 2
+            adapters[name] = sub
+    ptr = pub.publish(step, draft=draft, weights=weights,
+                      adapters=adapters)
     events.flush()
     return 0 if ptr is not None else 2
 

@@ -47,6 +47,7 @@ from ..ft.retry import RetryDeadlineExceeded, retry_with_backoff
 from ..obs import events
 from ..obs.registry import REGISTRY
 from ..utils.logging import (
+    AUDIT_ADAPTER_FMT,
     AUDIT_RELOAD_FMT,
     AUDIT_RELOAD_REJECTED_FMT,
     logger,
@@ -216,6 +217,44 @@ class HotReloader:
                 # installed — a reload_signal lands here
                 self.chaos.on_reload(self.reloads + 1)
             self.engine.reload_params(params)
+            adapters_swapped = 0
+            if ptr.adapters:
+                # Tenant adapter hot-swap, in the SAME pause and equally
+                # recompile-free (the programs take the adapter pool per
+                # call): each verified sub-pointer registers its artifact
+                # and, when that adapter is resident, pages the new
+                # version in ALONGSIDE the old one — in-flight slots keep
+                # decoding the version they pinned until they drain
+                # (adapters.py swap/release). A pool too full to hold
+                # both versions defers THAT adapter (old keeps serving);
+                # it never rejects the weights swap.
+                mgr = getattr(self.engine, "adapters", None)
+                if mgr is None:
+                    logger.warning(
+                        "[DEPLOY] pointer carries %d adapter sub-"
+                        "pointer(s) but serving was built without "
+                        "adapter serving (adapter_rank=0); ignoring",
+                        len(ptr.adapters))
+                else:
+                    for name, sub in sorted(ptr.adapters.items()):
+                        art_dir = os.path.join(self.root,
+                                               str(sub["path"]))
+                        if mgr.swap(name, art_dir):
+                            adapters_swapped += 1
+                            events.emit_audit(
+                                logger, AUDIT_ADAPTER_FMT.format(
+                                    action="swap", name=name,
+                                    pages=mgr.layout.pages_per_adapter,
+                                    detail=f"step {sub.get('step', 0)} "
+                                           f"in-flight slots preserved"),
+                                "adapter", name=name,
+                                step=int(sub.get("step", 0)))
+                        else:
+                            logger.warning(
+                                "[DEPLOY] adapter %s swap deferred: the "
+                                "adapter pool cannot hold the new "
+                                "version alongside the in-flight one",
+                                name)
             if draft_params is not None:
                 self.engine.reload_draft_params(draft_params)
                 if self.adaptive_k is not None:
@@ -246,6 +285,7 @@ class HotReloader:
                                     ms=dt * 1e3),
             "weights_reload", step=int(ptr.step), old=current, dur=dt,
             active=len(self.scheduler.active), draft=bool(ptr.draft),
-            weights=bool(ptr.weights), artifact_bytes=art_bytes)
+            weights=bool(ptr.weights), artifact_bytes=art_bytes,
+            adapters=adapters_swapped)
         events.flush()
         return True

@@ -1,0 +1,475 @@
+"""Paged multi-tenant LoRA adapter serving (S-LoRA / Punica style).
+
+One base model serves K per-tenant LoRA adapters concurrently. The
+adapter weights — per-layer low-rank (A, B) factors for the wq and wv
+projections — live in a THIRD paged pool next to the target/draft KV
+pools: a flat ``(num_pages, page_elems)`` fp32 array on device, carved
+into fixed-size pages by the same :class:`~.scheduler.BlockAllocator`
+the KV pools use (alloc / refcount / double-free raise — page 0 is the
+reserved NULL page, all zeros, mirroring KV null block 0). Each resident
+adapter owns ``pages_per_adapter`` pages holding its factors flattened
+in a STATIC layout (:class:`AdapterLayout`), so the fused decode/prefill
+programs can gather one slot's whole adapter with a single
+``pool[page_rows]`` table lookup — the scalar-prefetched-table trick the
+paged KV kernels use — and then slice per-layer factors at static
+offsets. The LoRA contribution itself,
+
+    ``y = Wx + B(Ax) * (alpha / r)``
+
+is computed inside the existing batched dispatch with the batch as a
+PARALLEL einsum dim and a per-slot ``jnp.where`` gate on the scale:
+slots carrying the null adapter (scale 0) select the base activations
+bitwise unchanged, and each slot's delta depends only on its own gathered
+pages — which is what makes K-adapter concurrent streams bit-match K
+sequential single-adapter runs (tests/test_adapter_serving.py).
+
+Adapters ship as CRC-manifested ARTIFACTS (the checkpoint manifest
+machinery: per-file size+CRC ``integrity.json``, tmp+rename commit), are
+published through deploy/publish.py as a ``adapters`` sub-pointer in
+``published.json``, and are verified BEFORE any pool write — a corrupt
+artifact raises :class:`AdapterIntegrityError` with the pool untouched.
+Cold adapters evict under pool pressure (LRU among records with no
+active slots); a request naming an unresident adapter queues behind a
+verified page-in at admission instead of crashing. Hot-swap pages the
+new version in ALONGSIDE the old one: in-flight slots keep decoding
+against their pinned pages (the allocator refcount holds them) and the
+old version's pages free when the last such slot drains — no recompile,
+no stream disturbance, same prefill-pause the PR 7 weight reload uses.
+"""
+
+import dataclasses
+import json
+import math
+import os
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..checkpoint.manager import (
+    MANIFEST_NAME,
+    _fsync_dir,
+    verify_step_dir,
+    write_manifest,
+)
+
+ADAPTER_META_NAME = "adapter.json"
+
+#: factor file names inside an adapter artifact directory, in the flat
+#: layout's per-layer order (A then B, q then v)
+_FACTOR_FILES = ("a_q.npy", "b_q.npy", "a_v.npy", "b_v.npy")
+
+
+class AdapterIntegrityError(RuntimeError):
+    """An adapter artifact failed its verify-before-load sweep (size/CRC
+    mismatch, missing manifest, geometry drift). Raised with the adapter
+    pool — and the serving params — untouched."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterLayout:
+    """STATIC flat layout of one adapter's factors in the paged pool.
+
+    Per layer, in order: ``A_q`` (dim, r), ``B_q`` (r, n_heads*head_dim),
+    ``A_v`` (dim, r), ``B_v`` (r, kv_heads*head_dim), each flattened
+    C-order; layers concatenated; the whole vector zero-padded to
+    ``pages_per_adapter * page_elems``. Both the host flatten
+    (:meth:`flatten`) and the traced per-layer slicing
+    (:meth:`slice_layers`) derive from the same offsets, so what the
+    manager writes is exactly what the programs read."""
+
+    n_layers: int
+    dim: int
+    n_q: int
+    n_kv: int
+    rank: int
+    page_elems: int
+
+    @classmethod
+    def from_cfg(cls, cfg, rank: int,
+                 page_elems: Optional[int] = None) -> "AdapterLayout":
+        dh = cfg.head_dim
+        pe = int(page_elems) if page_elems else int(cfg.dim * rank)
+        return cls(n_layers=int(cfg.n_layers), dim=int(cfg.dim),
+                   n_q=int(cfg.n_heads * dh), n_kv=int(cfg.kv_heads * dh),
+                   rank=int(rank), page_elems=pe)
+
+    @property
+    def a_elems(self) -> int:
+        return self.dim * self.rank
+
+    @property
+    def layer_elems(self) -> int:
+        return (2 * self.a_elems + self.rank * self.n_q
+                + self.rank * self.n_kv)
+
+    @property
+    def total_elems(self) -> int:
+        return self.n_layers * self.layer_elems
+
+    @property
+    def pages_per_adapter(self) -> int:
+        return max(1, math.ceil(self.total_elems / self.page_elems))
+
+    @property
+    def padded_elems(self) -> int:
+        return self.pages_per_adapter * self.page_elems
+
+    @property
+    def adapter_bytes(self) -> int:
+        """Device footprint of one resident adapter (fp32 pages)."""
+        return self.padded_elems * 4
+
+    def factor_shapes(self) -> Tuple[Tuple[int, ...], ...]:
+        ln, d, r = self.n_layers, self.dim, self.rank
+        return ((ln, d, r), (ln, r, self.n_q),
+                (ln, d, r), (ln, r, self.n_kv))
+
+    def flatten(self, a_q, b_q, a_v, b_v) -> np.ndarray:
+        """Factors -> ``(pages_per_adapter, page_elems)`` fp32 pages."""
+        arrs = (a_q, b_q, a_v, b_v)
+        for arr, want in zip(arrs, self.factor_shapes()):
+            if tuple(np.shape(arr)) != want:
+                raise ValueError(
+                    f"adapter factor shape {tuple(np.shape(arr))} does "
+                    f"not match layout {want}")
+        flat = np.zeros((self.padded_elems,), np.float32)
+        off = 0
+        for layer in range(self.n_layers):
+            for arr in arrs:
+                chunk = np.asarray(arr[layer], np.float32).reshape(-1)
+                flat[off:off + chunk.size] = chunk
+                off += chunk.size
+        return flat.reshape(self.pages_per_adapter, self.page_elems)
+
+    def slice_layers(self, flat):
+        """Traced inverse of :meth:`flatten`: ``flat`` (B, padded_elems)
+        -> one ``(A_q, B_q, A_v, B_v)`` tuple per layer, each factor
+        carrying the leading batch dim. Static slices only — the whole
+        per-slot gather is the single ``pool[rows]`` the caller ran."""
+        b = flat.shape[0]
+        d, r = self.dim, self.rank
+        sizes = (d * r, r * self.n_q, d * r, r * self.n_kv)
+        shapes = ((b, d, r), (b, r, self.n_q), (b, d, r), (b, r, self.n_kv))
+        out = []
+        off = 0
+        for _ in range(self.n_layers):
+            factors = []
+            for size, shape in zip(sizes, shapes):
+                factors.append(flat[:, off:off + size].reshape(shape))
+                off += size
+            out.append(tuple(factors))
+        return out
+
+
+def init_adapter_factors(layout: AdapterLayout, seed: int,
+                         scale: float = 0.02):
+    """Deterministic toy factors for tests/bench: seeded normal A, seeded
+    normal B (real LoRA zero-inits B; non-zero here so every adapter
+    visibly changes the stream)."""
+    rng = np.random.default_rng(int(seed))
+    return tuple(
+        np.asarray(rng.normal(0.0, scale, size=shape), np.float32)
+        for shape in layout.factor_shapes())
+
+
+# --- artifact write / verified load ---------------------------------------
+
+
+def write_adapter_artifact(root: str, name: str, step: int, factors, *,
+                           rank: int, alpha: float) -> dict:
+    """Commit one adapter version as a CRC-manifested artifact directory
+    ``{root}/adapter_{name}/{step}`` (the ``write_weights_artifact``
+    discipline: build in a ``.tmp`` sibling, write the integrity manifest
+    last, rename into place). Returns the pointer's per-adapter
+    sub-entry dict for ``published.json``'s ``adapters`` map."""
+    from ..deploy.publish import manifest_digest
+
+    root = os.path.abspath(root)
+    final = os.path.join(root, f"adapter_{name}", str(int(step)))
+    tmp = final + ".tmp"
+    for d in (final, tmp):
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    os.makedirs(tmp)
+    nbytes = 0
+    shapes = []
+    for fname, arr in zip(_FACTOR_FILES, factors):
+        arr = np.asarray(arr, np.float32)
+        np.save(os.path.join(tmp, fname), arr)
+        shapes.append(list(arr.shape))
+        nbytes += arr.nbytes
+    meta = {"version": 1, "name": str(name), "step": int(step),
+            "rank": int(rank), "alpha": float(alpha),
+            "nbytes": int(nbytes), "shapes": shapes}
+    with open(os.path.join(tmp, ADAPTER_META_NAME), "w") as fh:
+        json.dump(meta, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    write_manifest(tmp, int(step))
+    os.rename(tmp, final)
+    _fsync_dir(os.path.dirname(final))
+    return {"name": str(name), "step": int(step),
+            "path": os.path.relpath(final, root),
+            "manifest_digest": manifest_digest(final),
+            "rank": int(rank), "alpha": float(alpha)}
+
+
+def load_adapter_artifact(art_dir: str):
+    """Verify-then-load one adapter artifact: every manifest-listed file
+    passes its size+CRC check BEFORE any byte is trusted (the checkpoint
+    sweep, ``verify_step_dir``), then the meta and the four factor arrays
+    are loaded and geometry-checked. Raises :class:`AdapterIntegrityError`
+    on any mismatch — the caller's pool and params are untouched.
+    Returns ``(meta, (a_q, b_q, a_v, b_v))``."""
+    if not os.path.isfile(os.path.join(art_dir, MANIFEST_NAME)):
+        raise AdapterIntegrityError(
+            f"adapter artifact has no integrity manifest: {art_dir}")
+    ok, detail = verify_step_dir(art_dir)
+    if not ok:
+        raise AdapterIntegrityError(
+            f"adapter artifact failed integrity check ({art_dir}): "
+            f"{detail}")
+    try:
+        with open(os.path.join(art_dir, ADAPTER_META_NAME)) as fh:
+            meta = json.load(fh)
+        factors = tuple(np.load(os.path.join(art_dir, f))
+                        for f in _FACTOR_FILES)
+    except (OSError, ValueError, KeyError) as e:
+        raise AdapterIntegrityError(
+            f"adapter artifact unreadable ({art_dir}): {e}")
+    for arr, want in zip(factors, meta.get("shapes", [])):
+        if list(arr.shape) != list(want):
+            raise AdapterIntegrityError(
+                f"adapter artifact geometry mismatch ({art_dir}): "
+                f"{list(arr.shape)} != {list(want)}")
+    return meta, factors
+
+
+# --- residency manager ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Record:
+    """One resident adapter VERSION. ``pages`` carry one allocator ref
+    held by the manager (dropped at evict/retire) plus one per active
+    slot — the pages physically free only when both are gone, which is
+    the whole hot-swap/drain story."""
+
+    name: str
+    step: int
+    pages: List[int]
+    row: np.ndarray
+    scale: float
+    active: set = dataclasses.field(default_factory=set)
+    last_use: int = 0
+    stale: bool = False
+
+
+class AdapterManager:
+    """Host-side residency/refcount bookkeeping over the paged adapter
+    pool. The engine owns the device array and hands the manager a
+    ``write_pages(pages, values)`` callback; the scheduler drives
+    admission (``page_in``/``acquire``) and release (``release``)."""
+
+    def __init__(self, layout: AdapterLayout, num_pages: int, write_pages):
+        from .scheduler import BlockAllocator  # circular at module scope
+
+        if num_pages < layout.pages_per_adapter + 1:
+            raise ValueError(
+                f"adapter pool of {num_pages} page(s) cannot hold one "
+                f"adapter ({layout.pages_per_adapter} page(s) + null "
+                f"page 0)")
+        self.layout = layout
+        self.num_pages = int(num_pages)
+        self.allocator = BlockAllocator(int(num_pages))
+        self._write_pages = write_pages
+        self._paths: Dict[str, str] = {}
+        self._current: Dict[str, _Record] = {}
+        self._stale: List[_Record] = []
+        self._slot_rec: Dict[int, _Record] = {}
+        self._tick = 0
+        self.pageins = 0
+        self.evictions = 0
+        self.served: set = set()
+
+    # -- registration / residency --
+
+    def register(self, name: str, art_dir: str) -> None:
+        """Bind ``name`` to its (newest) published artifact directory —
+        what a later page-in verifies and loads."""
+        if not name:
+            raise ValueError("the null adapter '' cannot be registered")
+        self._paths[str(name)] = str(art_dir)
+
+    def known(self, name: str) -> bool:
+        return not name or name in self._paths or name in self._current
+
+    def resident(self, name: str) -> bool:
+        return not name or name in self._current
+
+    def pages_needed(self, name: str) -> int:
+        """Pages a page-in of ``name`` would consume right now (0 when
+        resident or null) — the adapter half of the scheduler's combined
+        KV+adapter admission footprint."""
+        return 0 if self.resident(name) else self.layout.pages_per_adapter
+
+    # -- page-in / eviction --
+
+    def _load_record(self, name: str) -> Optional[_Record]:
+        """Verify+load ``name``'s artifact and land it in freshly
+        allocated pages (evicting cold adapters as needed). Returns the
+        new record, or None if even eviction cannot free enough pages.
+        Raises :class:`AdapterIntegrityError` / ``ValueError`` with the
+        pool untouched on a bad artifact."""
+        art = self._paths.get(name)
+        if art is None:
+            raise KeyError(f"unknown adapter {name!r}: not registered and "
+                           f"not in the published pointer")
+        meta, factors = load_adapter_artifact(art)
+        if int(meta.get("rank", -1)) != self.layout.rank:
+            raise ValueError(
+                f"adapter {name!r} rank {meta.get('rank')} does not match "
+                f"the engine's adapter_rank {self.layout.rank}")
+        flat = self.layout.flatten(*factors)
+        pages = self._alloc_with_eviction(self.layout.pages_per_adapter)
+        if pages is None:
+            return None
+        self._write_pages(pages, flat)
+        self.pageins += 1
+        self._tick += 1
+        scale = float(meta.get("alpha", self.layout.rank)) / self.layout.rank
+        return _Record(name=name, step=int(meta.get("step", 0)),
+                       pages=list(pages),
+                       row=np.asarray(pages, np.int32),
+                       scale=scale, last_use=self._tick)
+
+    def _alloc_with_eviction(self, n: int) -> Optional[List[int]]:
+        while True:
+            pages = self.allocator.alloc(n)
+            if pages is not None:
+                return pages
+            victim = None
+            for rec in self._current.values():
+                if rec.active:
+                    continue
+                if victim is None or rec.last_use < victim.last_use:
+                    victim = rec
+            if victim is None:
+                return None
+            self.evict(victim.name)
+
+    def evict(self, name: str) -> bool:
+        """Drop ``name`` from residency (LRU pressure path, or explicit).
+        Refuses while any slot still decodes against it."""
+        rec = self._current.get(name)
+        if rec is None or rec.active:
+            return False
+        del self._current[name]
+        self.allocator.free(rec.pages)
+        self.evictions += 1
+        return True
+
+    def page_in(self, name: str) -> bool:
+        """Make ``name`` resident (verified artifact -> pool pages).
+        True if resident on return; False if the pool cannot hold it even
+        after evicting every cold adapter — the caller leaves the request
+        queued behind the page-in. Raises ``KeyError`` for an
+        unregistered name and :class:`AdapterIntegrityError` for a
+        corrupt artifact, both with the pool untouched."""
+        if self.resident(name):
+            return True
+        rec = self._load_record(name)
+        if rec is None:
+            return False
+        self._current[name] = rec
+        return True
+
+    # -- slot binding --
+
+    def acquire(self, name: str, slot: int) -> Tuple[np.ndarray, float]:
+        """Pin ``name``'s current version to ``slot`` (+1 allocator ref
+        per page) and return ``(page_row, scale)`` for the slot's decode
+        rows. The null adapter pins nothing and rows divert to null
+        page 0 with scale 0 — the base-only gate."""
+        if not name:
+            return (np.zeros((self.layout.pages_per_adapter,), np.int32),
+                    0.0)
+        rec = self._current[name]
+        self.allocator.incref(rec.pages)
+        rec.active.add(int(slot))
+        self._tick += 1
+        rec.last_use = self._tick
+        self._slot_rec[int(slot)] = rec
+        self.served.add(name)
+        return rec.row.copy(), rec.scale
+
+    def release(self, slot: int) -> None:
+        """Drop ``slot``'s pin. A STALE version (hot-swapped away) whose
+        last slot just drained frees its pages here — the deferred half
+        of :meth:`swap`."""
+        rec = self._slot_rec.pop(int(slot), None)
+        if rec is None:
+            return
+        rec.active.discard(int(slot))
+        self.allocator.free(rec.pages)
+        if rec.stale and not rec.active:
+            self._stale.remove(rec)
+            self.allocator.free(rec.pages)
+
+    # -- hot swap --
+
+    def swap(self, name: str, art_dir: Optional[str] = None) -> bool:
+        """Hot-swap ``name`` to the artifact at ``art_dir`` (or its
+        registered path). The new version is paged in ALONGSIDE the old
+        one first — on any failure the old version keeps serving — then
+        the old record either frees immediately (no active slots) or goes
+        stale and frees when its last in-flight slot drains. Future
+        admissions see the new version; in-flight slots are undisturbed.
+        No-op (registration only) while ``name`` is not resident."""
+        if art_dir is not None:
+            self.register(name, art_dir)
+        old = self._current.get(name)
+        if old is None:
+            return True
+        rec = self._load_record(name)
+        if rec is None:
+            return False
+        if old.active:
+            old.stale = True
+            self._stale.append(old)
+        else:
+            self.allocator.free(old.pages)
+        self._current[name] = rec
+        return True
+
+    # -- accounting --
+
+    def resident_pages(self) -> int:
+        return (sum(len(r.pages) for r in self._current.values())
+                + sum(len(r.pages) for r in self._stale))
+
+    def resident_bytes(self) -> int:
+        return self.resident_pages() * self.layout.page_elems * 4
+
+    def active_slots(self) -> Dict[str, int]:
+        """Active slot count per adapter name (stale versions fold into
+        their name) — the ``adapter_slots_active{adapter=}`` gauge."""
+        counts: Dict[str, int] = {}
+        for rec in list(self._current.values()) + self._stale:
+            if rec.active:
+                counts[rec.name] = counts.get(rec.name, 0) + len(rec.active)
+        return counts
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "resident": sorted(self._current),
+            "resident_pages": self.resident_pages(),
+            "resident_bytes": self.resident_bytes(),
+            "stale_versions": len(self._stale),
+            "pageins": self.pageins,
+            "evictions": self.evictions,
+            "served": len(self.served),
+            "active_slots": self.active_slots(),
+            "free_pages": self.allocator.free_count,
+        }

@@ -282,7 +282,10 @@ class InferenceEngine:
                  prefix_cache: bool = True,
                  paged_kernel: str = "gather",
                  prefill_batch: int = 1,
-                 kv_dtype: str = "bf16"):
+                 kv_dtype: str = "bf16",
+                 adapter_rank: int = 0,
+                 adapter_num_pages: int = 0,
+                 adapter_page_elems: int = 0):
         if kv_layout not in ("paged", "ring"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
         if kv_dtype not in ("bf16", "int8"):
@@ -437,6 +440,48 @@ class InferenceEngine:
             # as the draft-key stream stride (rungs never alias).
             self._tree_refeed = shape.depth + 1
 
+        # --- multi-tenant LoRA adapter serving (inference/adapters.py) -----
+        # A THIRD paged pool next to the target/draft KV pools: flat fp32
+        # pages holding per-adapter low-rank factors, page 0 the reserved
+        # null page. The fused programs take (pool, per-slot page rows,
+        # per-slot scales) as trailing args ONLY when adapter_rank > 0, so
+        # a no-adapter engine's programs are byte-identical to before; the
+        # pool is passed per call (like params, never donated), which is
+        # what makes page-in and hot-swap recompile-free.
+        self.adapter_rank = int(adapter_rank)
+        self.adapters = None
+        self._adapter_layout = None
+        self.adapter_pool = None
+        if self.adapter_rank:
+            if kv_layout != "paged":
+                raise ValueError("adapter serving requires the paged KV "
+                                 "layout (the adapter pool reuses the "
+                                 "block-pool substrate)")
+            if self.spec_k:
+                raise ValueError(
+                    "adapter serving and speculative decoding are mutually "
+                    "exclusive: the draft model has no per-tenant factors, "
+                    "so a draft proposal distribution would diverge from "
+                    "every adapter's target and the verify pass would "
+                    "reject its way back to plain decode")
+            from .adapters import AdapterLayout, AdapterManager
+
+            self._adapter_layout = AdapterLayout.from_cfg(
+                cfg, self.adapter_rank,
+                page_elems=adapter_page_elems or None)
+            per = self._adapter_layout.pages_per_adapter
+            # default pool: 4 resident adapters + the null page
+            self.adapter_num_pages = int(adapter_num_pages) or 4 * per + 1
+            self.adapter_pool = jnp.zeros(
+                (self.adapter_num_pages, self._adapter_layout.page_elems),
+                jnp.float32)
+            self.adapters = AdapterManager(
+                self._adapter_layout, self.adapter_num_pages,
+                self._write_adapter_pages)
+        elif adapter_num_pages or adapter_page_elems:
+            raise ValueError("adapter pool sizing given but "
+                             "adapter_rank == 0")
+
         with use_mesh(mesh):
             shardings = param_shardings(params, mesh)
             if shardings is not None:
@@ -469,6 +514,39 @@ class InferenceEngine:
         return init_paged_cache(self.draft_cfg, self.slots, self.max_len,
                                 self.block_size, self.draft_num_blocks,
                                 dtype=dtype)
+
+    # --- adapter pool (multi-tenant LoRA) ----------------------------------
+
+    def _write_adapter_pages(self, pages, values) -> None:
+        """Land one adapter's flattened factors in pool rows ``pages`` —
+        the AdapterManager's device write. A host-side scatter outside any
+        compiled program: the pool is a per-call input (never donated), so
+        the next dispatch simply reads the new bytes — no recompile."""
+        idx = np.asarray(pages, np.int32)
+        self.adapter_pool = self.adapter_pool.at[idx].set(
+            jnp.asarray(values, jnp.float32))
+
+    def _adapter_operand(self, apool, arows, ascales):
+        """Traced: gather each row's adapter pages from the pool in ONE
+        table lookup (the scalar-prefetched-table trick the paged KV
+        kernels use — rows of the null adapter hit zero page 0) and slice
+        the flat bytes into per-layer LoRA factor tuples for
+        ``forward_with_cache``. None when the engine has no adapters —
+        the programs trace exactly as before."""
+        if apool is None:
+            return None
+        flat = apool[arows].reshape(arows.shape[0], -1)
+        layers = self._adapter_layout.slice_layers(flat)
+        return [(a_q, b_q, a_v, b_v, ascales)
+                for (a_q, b_q, a_v, b_v) in layers]
+
+    def _null_adapter_args(self, batch: int):
+        """All-null (base-only) host-side adapter rows/scales for
+        ``batch`` rows — what the host API substitutes when the caller
+        passes none on an adapter-enabled engine."""
+        p = self._adapter_layout.pages_per_adapter
+        return (np.zeros((batch, p), np.int32),
+                np.zeros((batch,), np.float32))
 
     # --- compiled programs -------------------------------------------------
 
@@ -515,7 +593,7 @@ class InferenceEngine:
 
     def _paged_prefill_fn(self, model, params, cache, block_row, tokens,
                           slot, chunk_start, chunk_len, temperature, top_p,
-                          seed):
+                          seed, apool=None, arow=None, ascale=None):
         """One prefill CHUNK: (1, bucket) tokens at absolute positions
         ``chunk_start + [0, chunk_len)`` written through the slot's block
         ``block_row`` (blocks_per_slot,); pad positions past ``chunk_len``
@@ -528,10 +606,12 @@ class InferenceEngine:
         program body prefills the target and (spec mode) the draft."""
         valid = (jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
                  < chunk_len)
+        adapter = (None if apool is None else self._adapter_operand(
+            apool, arow[None, :], ascale[None]))
         logits, (nk, nv) = model.apply(
             {"params": params}, tokens, cache.k, cache.v, chunk_start[None],
             block_tables=block_row[None, :], write_valid=valid,
-            method="forward_with_cache")
+            adapter=adapter, method="forward_with_cache")
         lengths = jax.lax.dynamic_update_slice(
             cache.lengths, (chunk_start + chunk_len)[None], (slot,))
         last = jax.lax.dynamic_slice_in_dim(
@@ -542,7 +622,8 @@ class InferenceEngine:
 
     def _packed_prefill_fn(self, model, params, cache, block_rows, tokens,
                            slots, chunk_start, chunk_len, active,
-                           temperature, top_p, seeds):
+                           temperature, top_p, seeds, apool=None,
+                           arows=None, ascales=None):
         """P prefill CHUNKS in ONE dispatch: row i is request i's next
         (1, bucket) chunk at its OWN absolute offset ``chunk_start[i]``
         through its OWN block-table row — the batched sibling of
@@ -566,6 +647,7 @@ class InferenceEngine:
         logits, (nk, nv) = model.apply(
             {"params": params}, tokens, cache.k, cache.v, chunk_start,
             block_tables=block_rows, write_valid=valid,
+            adapter=self._adapter_operand(apool, arows, ascales),
             method="forward_with_cache")
         lengths = cache.lengths
         toks = []
@@ -584,7 +666,8 @@ class InferenceEngine:
         return PagedKVCache(k=nk, v=nv, lengths=lengths), jnp.stack(toks)
 
     def _paged_decode_fn(self, params, cache, block_tables, tokens, active,
-                         temperature, top_p, seeds, steps):
+                         temperature, top_p, seeds, steps, apool=None,
+                         arows=None, ascales=None):
         """One token for every slot through the block tables; inactive
         slots still run (static shapes) but their write diverts to the
         null block and their lengths do not advance. The sampling
@@ -596,14 +679,17 @@ class InferenceEngine:
         logits, (nk, nv) = self.model.apply(
             {"params": params}, tokens[:, None], cache.k, cache.v,
             cache.lengths, block_tables=block_tables,
-            write_valid=active[:, None], method="forward_with_cache")
+            write_valid=active[:, None],
+            adapter=self._adapter_operand(apool, arows, ascales),
+            method="forward_with_cache")
         last = logits[:, 0].astype(jnp.float32)
         toks = sample_slot_tokens(last, seeds, steps, temperature, top_p,
                                   self.top_k)
         lengths = cache.lengths + active.astype(jnp.int32)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), toks
 
-    def _paged_logits_fn(self, params, cache, block_tables, tokens, active):
+    def _paged_logits_fn(self, params, cache, block_tables, tokens, active,
+                         apool=None, arows=None, ascales=None):
         """UNFUSED decode step: the identical forward, but the program
         ends at the last-position fp32 logits — sampling is left to the
         host (which then pays a full (slots, V) sync plus a second
@@ -614,13 +700,16 @@ class InferenceEngine:
         logits, (nk, nv) = self.model.apply(
             {"params": params}, tokens[:, None], cache.k, cache.v,
             cache.lengths, block_tables=block_tables,
-            write_valid=active[:, None], method="forward_with_cache")
+            write_valid=active[:, None],
+            adapter=self._adapter_operand(apool, arows, ascales),
+            method="forward_with_cache")
         last = logits[:, 0].astype(jnp.float32)
         lengths = cache.lengths + active.astype(jnp.int32)
         return PagedKVCache(k=nk, v=nv, lengths=lengths), last
 
     def _burst_decode_fn(self, n, params, cache, block_tables, tokens,
-                         active, temperature, top_p, seeds, steps):
+                         active, temperature, top_p, seeds, steps,
+                         apool=None, arows=None, ascales=None):
         """A BURST of n chained decode micro-steps in ONE compiled program
         — the plain-decode sibling of the draft-k loop (``_draft_k_fn``):
         a ``lax.fori_loop`` whose body is one S=1 forward + the fused
@@ -649,13 +738,16 @@ class InferenceEngine:
         offsets = cache.lengths
         toks0 = jnp.zeros((b, n), jnp.int32)
         valid = active[:, None]
+        # rows/scales are loop-invariant: gather + slice once, reuse in
+        # every micro-step (the same per-slot factors all burst long)
+        adapter = self._adapter_operand(apool, arows, ascales)
 
         def body(i, carry):
             ck, cv, cur, toks = carry
             logits, (nk, nv) = self.model.apply(
                 {"params": params}, cur[:, None], ck, cv, offsets + i,
                 block_tables=block_tables, write_valid=valid,
-                method="forward_with_cache")
+                adapter=adapter, method="forward_with_cache")
             last = logits[:, 0].astype(jnp.float32)
             nxt = sample_slot_tokens(last, seeds, steps + i, temperature,
                                      top_p, self.top_k)
@@ -953,6 +1045,24 @@ class InferenceEngine:
             jnp.asarray(prim, jnp.int32)[None, :], (b, depth))
         return new_cache, out, acc, path
 
+    def _adapter_abstract(self, batch=None):
+        """Abstract trailing adapter args for the paged programs — a
+        ``(pool, rows (batch, P), scales (batch,))`` triple (batch
+        defaults to slots) and the B=1 prefill variant ``(pool, row (P,),
+        scalar scale)``. Both EMPTY tuples when the engine has no
+        adapters, so no-adapter lowerings are unchanged."""
+        if not self.adapter_rank:
+            return (), ()
+        b = self.slots if batch is None else batch
+        pool_abs = jax.ShapeDtypeStruct(
+            (self.adapter_num_pages, self._adapter_layout.page_elems),
+            jnp.float32)
+        per = self._adapter_layout.pages_per_adapter
+        return ((pool_abs, jax.ShapeDtypeStruct((b, per), jnp.int32),
+                 jax.ShapeDtypeStruct((b,), jnp.float32)),
+                (pool_abs, jax.ShapeDtypeStruct((per,), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.float32)))
+
     def _build_programs(self):
         p_abs, c_abs = _abstract(self.params), _abstract(self.cache)
         scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
@@ -966,13 +1076,18 @@ class InferenceEngine:
                 (self.slots, self.max_blocks_per_slot), jnp.int32)
             row_abs = jax.ShapeDtypeStruct((self.max_blocks_per_slot,),
                                            jnp.int32)
+            # adapter-enabled engines append (pool, page rows, scales) to
+            # the paged programs; without adapters the arg tuples are
+            # empty and the lowered programs are byte-identical to before
+            ad_slots, ad_one = self._adapter_abstract()
             self._decode = jax.jit(
                 self._paged_decode_fn, donate_argnums=(1,)).lower(
                 p_abs, c_abs, tables_abs, slots_i, slots_b, slots_f,
-                slots_f, slots_i, slots_i).compile()
+                slots_f, slots_i, slots_i, *ad_slots).compile()
             self._decode_logits = jax.jit(
                 self._paged_logits_fn, donate_argnums=(1,)).lower(
-                p_abs, c_abs, tables_abs, slots_i, slots_b).compile()
+                p_abs, c_abs, tables_abs, slots_i, slots_b,
+                *ad_slots).compile()
             # burst programs compile on first use (decode_burst(n) —
             # serving picks ONE n, so the ladder is usually one rung)
             self._burst_programs = {}
@@ -985,7 +1100,8 @@ class InferenceEngine:
                     functools.partial(self._paged_prefill_fn, self.model),
                     donate_argnums=(1,)).lower(
                     p_abs, c_abs, row_abs, tok_abs, scalar_i, scalar_i,
-                    scalar_i, scalar_f, scalar_f, scalar_i).compile()
+                    scalar_i, scalar_f, scalar_f, scalar_i,
+                    *ad_one).compile()
             self._packed_prefill = {}
             if self.prefill_batch > 1:
                 p = self.prefill_batch
@@ -994,6 +1110,7 @@ class InferenceEngine:
                 p_i = jax.ShapeDtypeStruct((p,), jnp.int32)
                 p_f = jax.ShapeDtypeStruct((p,), jnp.float32)
                 p_b = jax.ShapeDtypeStruct((p,), jnp.bool_)
+                ad_pack = self._adapter_abstract(batch=p)[0]
                 for b in self.prefill_buckets:
                     tok_abs = jax.ShapeDtypeStruct((p, b), jnp.int32)
                     self._packed_prefill[b] = jax.jit(
@@ -1001,7 +1118,7 @@ class InferenceEngine:
                                           self.model),
                         donate_argnums=(1,)).lower(
                         p_abs, c_abs, rows_abs, tok_abs, p_i, p_i, p_i,
-                        p_b, p_f, p_f, p_i).compile()
+                        p_b, p_f, p_f, p_i, *ad_pack).compile()
             if self.spec_k:
                 dp_abs = _abstract(self.draft_params)
                 dc_abs = _abstract(self.draft_cache)
@@ -1126,7 +1243,7 @@ class InferenceEngine:
             functools.partial(self._burst_decode_fn, n),
             donate_argnums=(1,)).lower(
             p_abs, c_abs, tables_abs, slots_i, slots_b, slots_f, slots_f,
-            slots_i, slots_i).compile()
+            slots_i, slots_i, *self._adapter_abstract()[0]).compile()
 
     def _burst_program(self, n: int):
         """The compiled n-token burst program, compiling on first use.
@@ -1292,8 +1409,40 @@ class InferenceEngine:
         self.cache = self.cache.replace(
             lengths=self.cache.lengths.at[slot].set(np.int32(int(length))))
 
+    def _adapter_call_args(self, rows, scales, batch=None):
+        """Host-side trailing adapter args for the batched paged programs
+        (empty tuple when the engine has no adapters). ``rows``/``scales``
+        default to all-null (base-only) so adapter-enabled engines serve
+        plain traffic without the caller carrying adapter state."""
+        if not self.adapter_rank:
+            if rows is not None or scales is not None:
+                raise ValueError("adapter rows given but engine built "
+                                 "without adapters (adapter_rank == 0)")
+            return ()
+        if rows is None or scales is None:
+            rows, scales = self._null_adapter_args(
+                self.slots if batch is None else batch)
+        return (self.adapter_pool, np.asarray(rows, np.int32),
+                np.asarray(scales, np.float32))
+
+    def _prefill_adapter_args(self, row, scale):
+        """Trailing adapter args for the B=1 prefill programs: one page
+        row + one scalar scale (None -> the null adapter)."""
+        if not self.adapter_rank:
+            if row is not None:
+                raise ValueError("adapter row given but engine built "
+                                 "without adapters (adapter_rank == 0)")
+            return ()
+        per = self._adapter_layout.pages_per_adapter
+        if row is None:
+            row, scale = np.zeros((per,), np.int32), 0.0
+        return (self.adapter_pool,
+                np.asarray(row, np.int32).reshape(per),
+                np.float32(scale))
+
     def _stream_chunks(self, draft: bool, row, ids, slot, temperature,
-                       top_p, seed, stop_check, on_chunk, start_pos=0):
+                       top_p, seed, stop_check, on_chunk, start_pos=0,
+                       adapter_row=None, adapter_scale=0.0):
         """Stream ``ids`` through the paged prefill bucket programs of the
         target (or, spec mode, the draft) model, beginning at absolute
         position ``start_pos`` (0 = full prompt; a prefix-cache hit resumes
@@ -1317,7 +1466,8 @@ class InferenceEngine:
                     self.draft_params, self.draft_cache, *args)
             else:
                 self.cache, tok = self._prefill[bucket](
-                    self.params, self.cache, *args)
+                    self.params, self.cache, *args,
+                    *self._prefill_adapter_args(adapter_row, adapter_scale))
             start += m
             if on_chunk is not None:
                 on_chunk()
@@ -1331,7 +1481,9 @@ class InferenceEngine:
                 stop_check: Optional[Callable[[], bool]] = None,
                 on_chunk: Optional[Callable[[], None]] = None,
                 start_pos: int = 0,
-                draft_start_pos: int = 0) -> Optional[int]:
+                draft_start_pos: int = 0,
+                adapter_row=None,
+                adapter_scale: float = 0.0) -> Optional[int]:
         """Prompt into ``slot``; returns the first generated token id.
 
         Ring layout: the prompt must fit the largest bucket (one shot).
@@ -1397,7 +1549,9 @@ class InferenceEngine:
             raise ValueError(f"start_pos {start_pos} outside [0, {n})")
         tok = self._stream_chunks(False, row, ids, slot, temperature, top_p,
                                   seed, stop_check, on_chunk,
-                                  start_pos=start_pos)
+                                  start_pos=start_pos,
+                                  adapter_row=adapter_row,
+                                  adapter_scale=adapter_scale)
         if tok is None:
             return None
         if self.spec_k:
@@ -1428,7 +1582,8 @@ class InferenceEngine:
                 return None
         return int(tok)
 
-    def prefill_packed(self, rows, bucket: int):
+    def prefill_packed(self, rows, bucket: int, adapter_rows=None,
+                       adapter_scales=None):
         """ONE packed prefill round: each entry of ``rows`` is a
         ``(slot, chunk_ids, start, block_row, temperature, top_p, seed)``
         tuple — request ``slot``'s NEXT prompt chunk (``chunk_ids``, at
@@ -1490,16 +1645,33 @@ class InferenceEngine:
             temp[i] = temperature
             tp[i] = top_p
             seeds[i] = seed
+        ad = ()
+        if self.adapter_rank:
+            per = self._adapter_layout.pages_per_adapter
+            a_rows = np.zeros((p, per), np.int32)
+            a_scales = np.zeros((p,), np.float32)
+            if adapter_rows is not None:
+                for i, (r, s) in enumerate(zip(adapter_rows,
+                                               adapter_scales)):
+                    a_rows[i] = np.asarray(r, np.int32).reshape(per)
+                    a_scales[i] = s
+            ad = (self.adapter_pool, a_rows, a_scales)
+        elif adapter_rows is not None:
+            raise ValueError("adapter rows given but engine built "
+                             "without adapters (adapter_rank == 0)")
         self.cache, out = self._packed_prefill[bucket](
             self.params, self.cache, block_rows, toks, slots, starts, lens,
-            active, temp, tp, seeds)
+            active, temp, tp, seeds, *ad)
         return [int(t) for t in np.asarray(out)[:len(rows)]]
 
     def decode_step(self, tokens, active, temperature, top_p, seeds, steps,
-                    block_tables=None) -> np.ndarray:
+                    block_tables=None, adapter_rows=None,
+                    adapter_scales=None) -> np.ndarray:
         """One decode iteration over all slots; host arrays in/out. The
         paged layout additionally takes the scheduler's (slots,
-        blocks_per_slot) block tables."""
+        blocks_per_slot) block tables, and adapter-enabled engines take
+        each slot's adapter page row + scale (``adapter_rows`` (slots, P)
+        / ``adapter_scales`` (slots,); None = all base-only)."""
         if self.kv_layout == "paged":
             if block_tables is None:
                 raise ValueError("paged decode requires block_tables")
@@ -1509,7 +1681,8 @@ class InferenceEngine:
                 np.asarray(tokens, np.int32), np.asarray(active, bool),
                 np.asarray(temperature, np.float32),
                 np.asarray(top_p, np.float32),
-                np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
+                np.asarray(seeds, np.int32), np.asarray(steps, np.int32),
+                *self._adapter_call_args(adapter_rows, adapter_scales))
             return np.asarray(toks)
         self.cache, toks = self._decode(
             self.params, self.cache,
@@ -1519,7 +1692,8 @@ class InferenceEngine:
             np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
         return np.asarray(toks)
 
-    def decode_logits(self, tokens, active, block_tables=None) -> np.ndarray:
+    def decode_logits(self, tokens, active, block_tables=None,
+                      adapter_rows=None, adapter_scales=None) -> np.ndarray:
         """UNFUSED decode iteration: run the forward, sync the (slots, V)
         fp32 logits to the host, sample nothing. The caller samples with
         sampler.py ``sample_slot_tokens`` — same function the fused
@@ -1532,11 +1706,13 @@ class InferenceEngine:
             raise ValueError("paged decode requires block_tables")
         self.cache, logits = self._decode_logits(
             self.params, self.cache, np.asarray(block_tables, np.int32),
-            np.asarray(tokens, np.int32), np.asarray(active, bool))
+            np.asarray(tokens, np.int32), np.asarray(active, bool),
+            *self._adapter_call_args(adapter_rows, adapter_scales))
         return np.asarray(logits)
 
     def decode_burst(self, tokens, active, temperature, top_p, seeds, steps,
-                     n, block_tables=None) -> np.ndarray:
+                     n, block_tables=None, adapter_rows=None,
+                     adapter_scales=None) -> np.ndarray:
         """A burst of ``n`` decode iterations in ONE dispatch + ONE host
         sync; returns (slots, n) token ids. Greedy streams are bit-equal
         to ``n`` sequential :meth:`decode_step` calls and sampled slots
@@ -1552,14 +1728,17 @@ class InferenceEngine:
         if n == 1:
             return self.decode_step(tokens, active, temperature, top_p,
                                     seeds, steps,
-                                    block_tables=block_tables)[:, None]
+                                    block_tables=block_tables,
+                                    adapter_rows=adapter_rows,
+                                    adapter_scales=adapter_scales)[:, None]
         prog = self._burst_program(n)
         self.cache, toks = prog(
             self.params, self.cache, np.asarray(block_tables, np.int32),
             np.asarray(tokens, np.int32), np.asarray(active, bool),
             np.asarray(temperature, np.float32),
             np.asarray(top_p, np.float32),
-            np.asarray(seeds, np.int32), np.asarray(steps, np.int32))
+            np.asarray(seeds, np.int32), np.asarray(steps, np.int32),
+            *self._adapter_call_args(adapter_rows, adapter_scales))
         return np.asarray(toks)
 
     def spec_round(self, tokens, lengths, active, temperature, top_p, seeds,

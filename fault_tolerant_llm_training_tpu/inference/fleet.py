@@ -72,9 +72,11 @@ from ..obs import events, reqtrace
 from ..obs.prometheus import MetricsServer
 from ..obs.registry import REGISTRY
 from ..utils.logging import (
+    AUDIT_ADAPTER_SUMMARY_FMT,
     AUDIT_FLEET_JOIN_FMT,
     AUDIT_FLEET_LEAVE_FMT,
     AUDIT_KV_QUANT_FMT,
+    AUDIT_KV_XPORT_FMT,
     AUDIT_KV_STORE_FMT,
     AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
@@ -207,6 +209,18 @@ def get_fleet_args(argv=None) -> argparse.Namespace:
                         "back to the committed-prefix replay")
     p.add_argument("--paged-kernel", default="gather",
                    choices=("gather", "pallas"))
+    p.add_argument("--adapter-rank", type=int, default=0,
+                   help="multi-tenant LoRA serving rank (serve.py "
+                        "--adapter-rank); 0 = off. Every fleet host must "
+                        "run the same rank or migrated adapter streams "
+                        "land on a host that cannot serve them")
+    p.add_argument("--adapter-pages", type=int, default=0,
+                   help="adapter page pool size incl. the null page "
+                        "(serve.py --adapter-pages); 0 = room for 4")
+    p.add_argument("--adapter", action="append", default=[],
+                   metavar="NAME=DIR", dest="adapters",
+                   help="register a published adapter artifact at startup "
+                        "(repeatable, serve.py --adapter)")
     p.add_argument("--compile-cache-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-eos", action="store_true")
@@ -331,7 +345,19 @@ def main(argv=None) -> None:
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks or None,
             paged_kernel=args.paged_kernel,
-            kv_dtype=args.kv_dtype)
+            kv_dtype=args.kv_dtype,
+            adapter_rank=args.adapter_rank,
+            adapter_num_pages=args.adapter_pages)
+        if args.adapters:
+            if not args.adapter_rank:
+                raise SystemExit("--adapter requires --adapter-rank")
+            for spec in args.adapters:
+                name, sep, art_dir = spec.partition("=")
+                if not (sep and name and art_dir):
+                    raise SystemExit(f"--adapter expects NAME=DIR, "
+                                     f"got {spec!r}")
+                engine.adapters.register(name, art_dir)
+                logger.info("Adapter registered | %s -> %s", name, art_dir)
         events.emit_audit(
             logger, AUDIT_SERVE_READY_FMT.format(
                 model=args.model, step=engine.restored_step,
@@ -344,9 +370,24 @@ def main(argv=None) -> None:
         # degrades to fs here, by construction rather than by failure.
         lane = resolve_lane(args.kv_transport, colocated=False)
         if lane != args.kv_transport:
-            logger.info("KV transport: requested %s lane degraded to fs "
-                        "— fleet peers are separate processes with no "
-                        "shared fabric", args.kv_transport)
+            # auditable, not just a log line: the degradation rides the
+            # same [KV XPORT] contract + fallback counter the scheduler's
+            # per-shipment mem->fs misses use, so a fleet that silently
+            # lost its fast lane shows up in both the audit grep and the
+            # /metrics rollup
+            events.emit_audit(
+                logger, AUDIT_KV_XPORT_FMT.format(
+                    action="degrade", lane=lane, id="-", blocks=0,
+                    detail=f"requested {args.kv_transport} lane — fleet "
+                           f"peers are separate processes with no shared "
+                           f"fabric"),
+                "kv_xport", action="degrade", lane=lane,
+                requested=args.kv_transport)
+            REGISTRY.counter(
+                "kv_transport_lane_fallbacks_total",
+                "Block-train imports that degraded from the mem lane to "
+                "the fs artifact (fabric miss or metadata digest "
+                "mismatch)").inc()
         transport = make_transport(lane)
         _M_KV_TRANSPORT.labels(lane=lane).set(1)
 
@@ -667,6 +708,21 @@ def main(argv=None) -> None:
             blocks_total=engine.num_blocks),
         "kv_quant", dtype=engine.kv_dtype, bytes_per_block=bpb,
         ratio=ratio, blocks_total=engine.num_blocks)
+    if sched.adapters is not None:
+        # multi-tenant adapter receipt, same line serve.py's drain emits
+        am = sched.metrics()
+        events.emit_audit(
+            logger, AUDIT_ADAPTER_SUMMARY_FMT.format(
+                served=am["adapters_served"],
+                pageins=am["adapter_pageins"],
+                evictions=am["adapter_evictions"],
+                resident_bytes=am["adapter_pages_resident_bytes"],
+                rejects=am["adapter_rejects"]),
+            "adapter_summary", served=am["adapters_served"],
+            pageins=am["adapter_pageins"],
+            evictions=am["adapter_evictions"],
+            resident_bytes=am["adapter_pages_resident_bytes"],
+            rejects=am["adapter_rejects"])
     # Per-request latency audit: the drain summary every SLO check greps.
     for c in sched.completed:
         events.emit_audit(

@@ -118,6 +118,7 @@ from ..obs.registry import (
     default_registry,
 )
 from ..utils.logging import (
+    AUDIT_ADAPTER_FMT,
     AUDIT_DISAGG_SHIP_FMT,
     AUDIT_HANDOFF_FMT,
     AUDIT_KV_LEAK_FMT,
@@ -230,6 +231,11 @@ class Request:
     # through the journal so a migrated request's trace joins across
     # hosts. Empty string = tracing off for this request.
     trace_id: str = ""
+    # Tenant LoRA adapter this request decodes under (adapters.py).
+    # "" = the null adapter: base-model-only, bit-identical to an
+    # engine without adapter serving. A registered-but-unresident name
+    # queues the request behind a verified page-in at admission.
+    adapter: str = ""
 
 
 @dataclasses.dataclass
@@ -570,6 +576,23 @@ class Scheduler:
         self.prefill_packed_rows = 0
         self.prefill_inplace_chunks = 0
         self.prefill_gather_chunks = 0
+        # Multi-tenant LoRA adapter serving (adapters.py): engines built
+        # with adapter_rank > 0 carry an AdapterManager; the scheduler
+        # keeps one adapter page row + scale per slot (the decode
+        # dispatch's gather operands) and accounts the COMBINED
+        # KV+adapter footprint at admission — a request naming an
+        # unresident adapter waits at the head of the queue until a
+        # verified page-in lands it (never crashes the loop).
+        self.adapters = getattr(engine, "adapters", None)
+        if self.adapters is not None:
+            per = self.adapters.layout.pages_per_adapter
+            self._adapter_rows = np.zeros((engine.slots, per), np.int32)
+            self._adapter_scales = np.zeros((engine.slots,), np.float32)
+            self._slot_adapter: Dict[int, str] = {}
+            self.adapter_waits = 0
+            self.adapter_rejects = 0
+            self._adapter_pageins_seen = 0
+            self._adapter_evictions_seen = 0
         # Dispatch/sync accounting (the fused-decode win in receipts):
         # how many device programs were launched and how many host syncs
         # were paid for the decode tokens generated.
@@ -789,6 +812,24 @@ class Scheduler:
             "kv_transport_lane_fallbacks_total",
             "Block-train imports that degraded from the mem lane to the "
             "fs artifact (fabric miss or metadata digest mismatch)")
+        self._m_adapter_slots = r.gauge(
+            "adapter_slots_active",
+            "Decode slots currently pinned to each LoRA adapter "
+            "(labelled by adapter; the null adapter is unlabelled base "
+            "traffic and is not counted)")
+        self._m_adapter_resident_bytes = r.gauge(
+            "adapter_pages_resident_bytes",
+            "LoRA factor bytes resident in the paged adapter pool "
+            "(stale hot-swapped versions included until their last "
+            "in-flight slot drains)")
+        self._m_adapter_pageins = r.counter(
+            "adapter_pagein_total",
+            "Adapter artifacts CRC-verified and paged into the adapter "
+            "pool (hot-swap loads included)")
+        self._m_adapter_evictions = r.counter(
+            "adapter_evictions_total",
+            "Cold adapters evicted from the adapter pool under page "
+            "pressure (refcount-0 residents only, LRU order)")
         # Content-addressed prefix reuse: only engines that OPT IN get the
         # cache (InferenceEngine sets enable_prefix_cache in paged mode;
         # test doubles without the attribute keep plain allocation).
@@ -881,6 +922,21 @@ class Scheduler:
                 f"request {request.id}: {len(committed)} committed tokens "
                 f"already meet max_new_tokens {request.max_new_tokens} — "
                 f"nothing to decode; the caller should record it done")
+        aname = str(getattr(request, "adapter", "") or "")
+        if aname:
+            # adapter serving is opt-in at engine build; an unregistered
+            # name is a caller error HERE (not a crash in the decode
+            # loop) — registered-but-unresident queues behind a verified
+            # page-in at admission
+            if self.adapters is None:
+                raise ValueError(
+                    f"request {request.id} names adapter {aname!r} but "
+                    f"the engine was built without adapter serving "
+                    f"(adapter_rank=0)")
+            if not self.adapters.known(aname):
+                raise ValueError(
+                    f"request {request.id} names unregistered adapter "
+                    f"{aname!r}")
         if len(request.prompt) + request.max_new_tokens > self.engine.max_len:
             raise ValueError(
                 f"request {request.id}: prompt {len(request.prompt)} + "
@@ -931,9 +987,36 @@ class Scheduler:
 
     # --- one decode iteration ----------------------------------------------
 
+    def _acquire_adapter(self, req: Request, slot: int) -> None:
+        """Pin ``req``'s adapter version to ``slot`` (+1 allocator ref
+        per page) and bank its gather operands. The null adapter pins
+        nothing — rows divert to null page 0 with scale 0, the base-only
+        gate. Callers guarantee residency (the admission gate's verified
+        page-in ran first)."""
+        if self.adapters is None:
+            return
+        aname = str(getattr(req, "adapter", "") or "")
+        arow, ascale = self.adapters.acquire(aname, slot)
+        self._adapter_rows[slot] = arow
+        self._adapter_scales[slot] = ascale
+        if aname:
+            self._slot_adapter[slot] = aname
+
+    def _release_adapter(self, slot: int) -> None:
+        """Drop a slot's adapter pin (slot freed, drain rollback, or
+        finish) and zero its gather operands — the next occupant starts
+        from the null divert."""
+        if self.adapters is None:
+            return
+        self.adapters.release(slot)
+        self._adapter_rows[slot] = 0
+        self._adapter_scales[slot] = 0.0
+        self._slot_adapter.pop(slot, None)
+
     def _finish(self, slot: int, reason: str, done: List[Completion]) -> None:
         st = self.active.pop(slot)
         self._ship_state.pop(st.request.id, None)
+        self._release_adapter(slot)
         if self.adaptive_k is not None:
             self.adaptive_k.forget(st.request.id)
         if self.kv_layout == "paged":
@@ -1036,6 +1119,45 @@ class Scheduler:
                             f"decode fleet has {decode_free} free "
                             f"block(s), admission deferred")
                     break
+            aname = str(getattr(req, "adapter", "") or "")
+            if aname and self.adapters is not None \
+                    and not self.adapters.resident(aname):
+                # Combined KV+adapter admission: the adapter half of the
+                # footprint must land (CRC-verified page-in, cold-adapter
+                # eviction under pressure) BEFORE any KV blocks are
+                # grabbed. A full pool leaves the head queued (FIFO, the
+                # same wait as KV shortage); a corrupt artifact rejects
+                # THIS request with the pool untouched — never a crash.
+                from .adapters import AdapterIntegrityError
+                try:
+                    paged_in = self.adapters.page_in(aname)
+                except (AdapterIntegrityError, KeyError) as e:
+                    self.queue.popleft()
+                    self.adapter_rejects += 1
+                    events.emit_audit(logger, AUDIT_ADAPTER_FMT.format(
+                        action="reject", name=aname,
+                        pages=self.adapters.layout.pages_per_adapter,
+                        detail=f"request {req.id}: {e}"), "adapter")
+                    now = self.clock()
+                    c = Completion(
+                        request_id=req.id, prompt_len=len(req.prompt),
+                        tokens=[], reason="adapter_rejected",
+                        submitted_at=submitted_at, first_token_at=now,
+                        finished_at=now,
+                        trace_id=str(getattr(req, "trace_id", "") or ""))
+                    self.completed.append(c)
+                    done.append(c)
+                    self._m_done.labels(reason="adapter_rejected").inc()
+                    self._trace(req, "done", reason="adapter_rejected")
+                    continue
+                if not paged_in:
+                    self.adapter_waits += 1
+                    break
+                events.emit_audit(logger, AUDIT_ADAPTER_FMT.format(
+                    action="page-in", name=aname,
+                    pages=self.adapters.layout.pages_per_adapter,
+                    detail=f"request {req.id} admitted behind verified "
+                           f"load"), "adapter")
             art_entry = self._handoff_artifacts.get(req.id)
             if (art_entry is not None and self.kv_layout == "paged"
                     and not self.spec_k):
@@ -1142,6 +1264,7 @@ class Scheduler:
                         break
             self.queue.popleft()
             slot = free.pop(0)
+            self._acquire_adapter(req, slot)
             self._trace(req, "queue", dur=self.clock() - submitted_at,
                         slot=slot)
             if self.kv_layout == "paged":
@@ -1218,6 +1341,11 @@ class Scheduler:
                     # only cache-aware engines accept the offset kwarg —
                     # test doubles without enable_prefix_cache never see it
                     spec_kw["start_pos"] = start_pos
+                if self.adapters is not None:
+                    # only adapter engines accept the adapter kwargs
+                    spec_kw["adapter_row"] = self._adapter_rows[slot]
+                    spec_kw["adapter_scale"] = float(
+                        self._adapter_scales[slot])
                 on_chunk = self._count_chunk
                 if self.role == "prefill":
                     # chunk-granular shipping: each finished chunk commits
@@ -1256,6 +1384,7 @@ class Scheduler:
                     if self.spec_k:
                         self.draft_allocator.free(slot_dblocks)
                         self.draft_block_tables[slot] = 0
+                    self._release_adapter(slot)
                     self.queue.appendleft((req, submitted_at))
                     self.stop_admission()
                     return
@@ -1424,6 +1553,9 @@ class Scheduler:
         del self._slot_blocks[slot]
         self.allocator.free(row_blocks)
         self.block_tables[slot] = 0
+        # the parked request drops its adapter pin too — a cold adapter
+        # may evict while it waits; the restore pages it back in verified
+        self._release_adapter(slot)
         self._set_spill_gauges()
         self._audit_tier("export", rid, len(private), nbytes)
         self._trace(st.request, "spill", blocks=len(private), bytes=nbytes)
@@ -1470,6 +1602,19 @@ class Scheduler:
         if sp is None:
             raise RuntimeError(f"request {rid} is not spilled — "
                                f"double restore")
+        aname = str(getattr(sp.request, "adapter", "") or "")
+        if aname and self.adapters is not None \
+                and not self.adapters.resident(aname):
+            # the adapter may have evicted while the request was parked:
+            # page it back in (verified) before touching any KV blocks,
+            # so a shortage or reject leaves both pools untouched
+            from .adapters import AdapterIntegrityError
+            try:
+                if not self.adapters.page_in(aname):
+                    return "wait"
+            except (AdapterIntegrityError, KeyError) as e:
+                self._spill_fallback(rid, f"adapter page-in rejected: {e}")
+                return "replay"
         bs = self.engine.block_size
         n_shared = len(sp.shared_tokens) // bs
         hit = None
@@ -1513,6 +1658,7 @@ class Scheduler:
         st.tokens = list(sp.tokens)
         st.steps = sp.steps
         st.emitted = list(sp.emitted)
+        self._acquire_adapter(sp.request, slot)
         self.active[slot] = st
         self.max_concurrent = max(self.max_concurrent, len(self.active))
         self._drop_spilled(rid)
@@ -1653,6 +1799,7 @@ class Scheduler:
             self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
         self._trace(req, "queue", dur=self.clock() - submitted_at,
                     slot=slot)
+        self._acquire_adapter(req, slot)
         st = self.active[slot] = _Slot(req, committed[-1], submitted_at,
                                        self.clock())
         self.handoff_imports += 1
@@ -1929,6 +2076,7 @@ class Scheduler:
             self._m_prefix_hit_rate.set(self.prefix_cache.hit_rate)
         self._trace(req, "queue", dur=self.clock() - submitted_at,
                     slot=slot)
+        self._acquire_adapter(req, slot)
         st = self.active[slot] = _Slot(req, committed[-1], submitted_at,
                                        self.clock())
         self.ship_imports += 1
@@ -2134,6 +2282,7 @@ class Scheduler:
             self.allocator.free(p.blocks)
             self.block_tables[p.slot] = 0
             self._ship_state.pop(p.request.id, None)
+            self._release_adapter(p.slot)
             self.queue.appendleft((p.request, p.submitted_at))
         self._pending_prefill.clear()
         self.stop_admission()
@@ -2202,8 +2351,16 @@ class Scheduler:
                  np.asarray(p.eff[p.pos:p.pos + m], np.int32),
                  p.pos, p.row, p.request.temperature, p.request.top_p,
                  p.request.seed) for p, m in batch]
+        packed_kw = {}
+        if self.adapters is not None:
+            # each packed row gathers ITS slot's adapter pages — one
+            # dispatch across rows with different adapters
+            packed_kw = dict(
+                adapter_rows=[self._adapter_rows[p.slot] for p, _ in batch],
+                adapter_scales=[float(self._adapter_scales[p.slot])
+                                for p, _ in batch])
         t0 = self.clock()
-        toks = self.engine.prefill_packed(rows, head_bucket)
+        toks = self.engine.prefill_packed(rows, head_bucket, **packed_kw)
         self.prefill_seconds += self.clock() - t0
         self.prefill_packed_rounds += 1
         self.prefill_packed_rows += len(rows)
@@ -2217,6 +2374,22 @@ class Scheduler:
             if p.pos >= len(p.eff):
                 self._pending_prefill.remove(p)
                 self._finish_prefill(p, tok, done)
+
+    def _sync_adapter_metrics(self) -> None:
+        """Mirror the AdapterManager's counters onto the /metrics surface
+        (the manager counts monotonically; the registry counters advance
+        by the delta since the last sync)."""
+        mgr = self.adapters
+        self._m_adapter_pageins.inc(mgr.pageins - self._adapter_pageins_seen)
+        self._adapter_pageins_seen = mgr.pageins
+        self._m_adapter_evictions.inc(
+            mgr.evictions - self._adapter_evictions_seen)
+        self._adapter_evictions_seen = mgr.evictions
+        self._m_adapter_resident_bytes.set(mgr.resident_bytes())
+        counts = mgr.active_slots()
+        for name in mgr.served:
+            self._m_adapter_slots.labels(adapter=name).set(
+                counts.get(name, 0))
 
     def step(self) -> List[Completion]:
         """Admit into free slots, run one decode iteration, evict finished
@@ -2238,6 +2411,8 @@ class Scheduler:
             self._m_block_util.set(util)
             self.max_block_utilization = max(self.max_block_utilization, util)
             self._m_blocks_shared.set(self.allocator.shared_count)
+        if self.adapters is not None:
+            self._sync_adapter_metrics()
         if not self.active:
             return done
         slots = self.engine.slots
@@ -2319,17 +2494,23 @@ class Scheduler:
             for st in self.active.values():
                 n = min(n, st.request.max_new_tokens - len(st.tokens))
             n = max(int(n), 1)
+            ad_kw = ({} if self.adapters is None else dict(
+                adapter_rows=self._adapter_rows,
+                adapter_scales=self._adapter_scales))
             burst_out = self.engine.decode_burst(
                 tokens, active, temperature, top_p, seeds, steps, n,
-                block_tables=self.block_tables)
+                block_tables=self.block_tables, **ad_kw)
             self.decode_dispatches += 1
             self.decode_host_syncs += 1
             self._m_dispatches.inc()
             self._m_host_syncs.inc()
         elif self.kv_layout == "paged":
+            ad_kw = ({} if self.adapters is None else dict(
+                adapter_rows=self._adapter_rows,
+                adapter_scales=self._adapter_scales))
             next_tokens = self.engine.decode_step(
                 tokens, active, temperature, top_p, seeds, steps,
-                block_tables=self.block_tables)
+                block_tables=self.block_tables, **ad_kw)
             self.decode_dispatches += 1
             self.decode_host_syncs += 1
             self._m_dispatches.inc()
@@ -2570,6 +2751,18 @@ class Scheduler:
                 leaks.append(AUDIT_KV_LEAK_FMT.format(
                     pool="draft", leaked=dextra,
                     used=self.draft_allocator.used_count, cached=dcached))
+        if self.adapters is not None:
+            # adapter-pool half of the guard: with no active slots every
+            # allocated adapter page belongs to a resident (or stale
+            # in-swap) record holding exactly its base reference — any
+            # surplus is a slot pin that never released
+            aused = self.adapters.allocator.used_count
+            aresident = self.adapters.resident_pages()
+            if (aused != aresident or self.adapters.allocator.shared_count
+                    or self._slot_adapter):
+                leaks.append(AUDIT_KV_LEAK_FMT.format(
+                    pool="adapter", leaked=aused - aresident,
+                    used=aused, cached=aresident))
         if self.enable_spill and self._spill_root is not None:
             # cross-tier half of the guard: every parked request must have
             # an intact artifact (manifest present), and every artifact
@@ -2671,6 +2864,18 @@ class Scheduler:
             out["kv_transport_lane_fallbacks"] = self.lane_fallbacks
         if self.pacing is not None or self.prefill_paced:
             out["prefill_paced"] = self.prefill_paced
+        if self.adapters is not None:
+            ast = self.adapters.stats()
+            out["adapters_served"] = ast["served"]
+            out["adapters_resident"] = list(ast["resident"])
+            out["adapter_pages_resident"] = ast["resident_pages"]
+            out["adapter_pages_resident_bytes"] = ast["resident_bytes"]
+            out["adapter_pageins"] = ast["pageins"]
+            out["adapter_evictions"] = ast["evictions"]
+            out["adapter_pool_pages_free"] = ast["free_pages"]
+            out["adapter_stale_versions"] = ast["stale_versions"]
+            out["adapter_waits"] = self.adapter_waits
+            out["adapter_rejects"] = self.adapter_rejects
         if self.kv_layout == "paged":
             out["kv_blocks_total"] = self.allocator.capacity
             out["kv_blocks_free"] = self.allocator.free_count

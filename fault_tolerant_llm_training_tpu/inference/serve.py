@@ -41,6 +41,7 @@ from ..obs.prometheus import MetricsServer
 from ..obs.registry import REGISTRY
 from ..utils.config import JOBID
 from ..utils.logging import (
+    AUDIT_ADAPTER_SUMMARY_FMT,
     AUDIT_KV_QUANT_FMT,
     AUDIT_LATENCY_FMT,
     AUDIT_REQUEST_DONE_FMT,
@@ -149,7 +150,8 @@ class _RequestFollower:
                                         self.args.temperature)),
                 top_p=float(d.get("top_p", self.args.top_p)),
                 seed=int(d.get("seed", self.args.seed + self.count)),
-                trace_id=trace_id))
+                trace_id=trace_id,
+                adapter=str(d.get("adapter", "") or "")))
             n += 1
         return n
 
@@ -307,6 +309,30 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                    help="draft KV pool blocks incl. the null block; 0 = "
                         "full reservation parity. The scheduler admits by "
                         "the COMBINED footprint across both pools")
+    p.add_argument("--adapter-rank", type=int, default=0,
+                   help="multi-tenant LoRA serving: low-rank adapter rank "
+                        "r (0 = adapter serving off). Adapter A/B factors "
+                        "page into a third block pool next to the KV "
+                        "pools; every slot carries its adapter's page rows "
+                        "into ONE fused decode dispatch, so slots serving "
+                        "DIFFERENT adapters batch together. Adapter '' is "
+                        "the null adapter — base-only, bit-identical to "
+                        "--adapter-rank 0 output")
+    p.add_argument("--adapter-pages", type=int, default=0,
+                   help="adapter page pool size incl. the null page; 0 = "
+                        "room for 4 adapters. Cold adapters evict under "
+                        "pressure (refcounted, like KV blocks) and reload "
+                        "CRC-verified from their published artifacts")
+    p.add_argument("--adapter", action="append", default=[],
+                   metavar="NAME=DIR", dest="adapters",
+                   help="register a published adapter artifact at startup "
+                        "(repeatable); requests name it via the 'adapter' "
+                        "field of a --request-file line. Requires "
+                        "--adapter-rank matching the artifact's rank")
+    p.add_argument("--prompt-adapter", action="append", default=[],
+                   metavar="NAME",
+                   help="adapter for the i-th --prompt (repeatable, "
+                        "positional; missing entries = '' base-only)")
     p.add_argument("--spec-verify-impl", default="exact",
                    choices=("exact", "chunk"),
                    help="verify-k scoring: 'exact' micro-steps k+1 S=1 "
@@ -468,7 +494,20 @@ def main(argv=None) -> None:
             prefix_cache=not args.no_prefix_cache,
             paged_kernel=args.paged_kernel,
             prefill_batch=args.prefill_batch,
-            kv_dtype=args.kv_dtype, **spec_kwargs)
+            kv_dtype=args.kv_dtype,
+            adapter_rank=args.adapter_rank,
+            adapter_num_pages=args.adapter_pages,
+            **spec_kwargs)
+        if args.adapters:
+            if not args.adapter_rank:
+                raise SystemExit("--adapter requires --adapter-rank")
+            for spec in args.adapters:
+                name, sep, art_dir = spec.partition("=")
+                if not (sep and name and art_dir):
+                    raise SystemExit(f"--adapter expects NAME=DIR, "
+                                     f"got {spec!r}")
+                engine.adapters.register(name, art_dir)
+                logger.info("Adapter registered | %s -> %s", name, art_dir)
         if args.kv_layout == "paged":
             # capacity surface for dashboards: bytes one block costs in
             # the selected storage dtype (scale rows included) and the
@@ -521,8 +560,8 @@ def main(argv=None) -> None:
                                     if args.kv_store_dir else None),
                           transport=transport,
                           kv_store_max_bytes=args.kv_store_max_bytes)
-        prompts = (args.prompt or ([] if args.follow else [_DEMO_PROMPT])
-                   ) * args.repeat
+        base_prompts = args.prompt or ([] if args.follow else [_DEMO_PROMPT])
+        prompts = base_prompts * args.repeat
         for i, text in enumerate(prompts):
             rid = f"req{i}"
             prompt = tokenizer.encode(text)
@@ -530,11 +569,15 @@ def main(argv=None) -> None:
             reqtrace.emit(trace_id, rid, "intake",
                           prompt_tokens=len(prompt),
                           max_new_tokens=args.max_new_tokens)
+            j = i % len(base_prompts) if base_prompts else 0
+            aname = (args.prompt_adapter[j]
+                     if j < len(args.prompt_adapter) else "")
             sched.submit(Request(
                 id=rid, prompt=prompt,
                 max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature, top_p=args.top_p,
-                seed=args.seed + i, trace_id=trace_id))
+                seed=args.seed + i, trace_id=trace_id,
+                adapter=aname))
         watcher = reloader = follower = None
         if args.follow:
             watcher = PointerWatcher(args.checkpoint_path)
@@ -718,6 +761,23 @@ def main(argv=None) -> None:
             cached_blocks=m["prefix_cached_blocks"],
             cow_copies=m["prefix_cow_copies"],
             evictions=m["prefix_evictions"])
+    if sched.adapters is not None:
+        # multi-tenant adapter receipt in the drain summary: how many
+        # distinct adapters this process served, page-in/eviction churn
+        # in the adapter pool, bytes still resident, and rejects (corrupt
+        # or unregistered artifacts that never reached the pool)
+        events.emit_audit(
+            logger, AUDIT_ADAPTER_SUMMARY_FMT.format(
+                served=m["adapters_served"],
+                pageins=m["adapter_pageins"],
+                evictions=m["adapter_evictions"],
+                resident_bytes=m["adapter_pages_resident_bytes"],
+                rejects=m["adapter_rejects"]),
+            "adapter_summary", served=m["adapters_served"],
+            pageins=m["adapter_pageins"],
+            evictions=m["adapter_evictions"],
+            resident_bytes=m["adapter_pages_resident_bytes"],
+            rejects=m["adapter_rejects"])
     # Per-request latency audit: the drain summary's SLO receipt — TTFT
     # and TPOT per completed request, keyed by the trace id that joins
     # this process's spans to the router's (obs/reqtrace.py)

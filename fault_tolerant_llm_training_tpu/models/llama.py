@@ -135,7 +135,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None):
+    def __call__(self, x, positions=None, cache=None, adapter=None):
         cfg = self.cfg
         dh = cfg.head_dim
         nq, nkv = cfg.n_heads * dh, cfg.kv_heads * dh
@@ -219,6 +219,33 @@ class Attention(nn.Module):
             #       paged TREE-verify: per-row rope positions + ancestor
             #       visibility over the speculative window
             from ..inference.kv_cache import write_paged_kv, write_slot_kv
+            if adapter is not None:
+                # Per-slot LoRA delta on the q/v projections (S-LoRA style
+                # multi-tenant serving, inference/adapters.py): each batch
+                # row carries ITS OWN low-rank factors — gathered from the
+                # paged adapter pool by the caller — so one dispatch serves
+                # slots bound to different adapters. The batch dim is a
+                # PARALLEL dim of both einsums (each row's contraction is
+                # independent of its neighbours), and a row whose scale is
+                # 0 (the null adapter) selects the base activations through
+                # jnp.where BITWISE — adapter-0 streams are bit-identical
+                # to a no-adapter engine, and concurrent heterogeneous
+                # streams bit-match sequential single-adapter runs.
+                # Applied BEFORE RoPE/cache writes: the delta is part of
+                # the projection, y = Wx + B(Ax) * (alpha/r).
+                a_q, b_q, a_v, b_v, a_scale = adapter
+                xf = x.astype(jnp.float32)
+                gate = (a_scale > 0.0)[:, None, None, None]
+                dq = jnp.einsum("bsd,bdr->bsr", xf, a_q)
+                dq = (jnp.einsum("bsr,brn->bsn", dq, b_q)
+                      * a_scale[:, None, None])
+                q = jnp.where(gate, q + dq.reshape(q.shape).astype(q.dtype),
+                              q)
+                dv = jnp.einsum("bsd,bdr->bsr", xf, a_v)
+                dv = (jnp.einsum("bsr,brn->bsn", dv, b_v)
+                      * a_scale[:, None, None])
+                v = jnp.where(gate, v + dv.reshape(v.shape).astype(v.dtype),
+                              v)
             if len(cache) == 7:
                 # Tree-verify: the S rows are one flattened token tree.
                 # Node i's KV lands at cache position ``offsets[b] + i``
@@ -402,14 +429,14 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None):
+    def __call__(self, x, positions=None, cache=None, adapter=None):
         cfg = self.cfg
         normed = RMSNorm(cfg.dim, cfg.norm_eps, cfg.param_dtype,
                          name="attention_norm")(x)
         attn = Attention(cfg, name="attention")
         new_cache = None
         if cache is not None:
-            attn_out, new_cache = attn(normed, positions, cache)
+            attn_out, new_cache = attn(normed, positions, cache, adapter)
         else:
             attn_out = attn(normed, positions)
         h = x + attn_out
@@ -524,7 +551,8 @@ class Transformer(nn.Module):
         return constrain(logits, "batch", "seq", "vocab")
 
     def forward_with_cache(self, tokens, cache_k, cache_v, offsets,
-                           block_tables=None, write_valid=None):
+                           block_tables=None, write_valid=None,
+                           adapter=None):
         """Prefill/decode forward through per-layer KV caches.
 
         ``tokens`` (B, S) occupy absolute positions ``offsets[b] + [0, S)``;
@@ -536,7 +564,11 @@ class Transformer(nn.Module):
         (B, S) masks which new positions are real (padding/inactive writes
         divert to null block 0; default: all valid). Loop trunk only — the
         inference engine converts scan-form checkpoints with
-        :func:`unstack_layer_params`. Returns
+        :func:`unstack_layer_params`. ``adapter`` is an optional
+        length-n_layers sequence of per-layer LoRA operand tuples
+        ``(A_q, B_q, A_v, B_v, scale)`` — each factor with a leading batch
+        dim, sliced by the engine from its paged adapter pool
+        (inference/adapters.py); None means base-only everywhere. Returns
         ``(logits, (new_cache_k, new_cache_v))``.
         """
         if self.cfg.layer_impl != "loop":
@@ -551,7 +583,8 @@ class Transformer(nn.Module):
             c = ((cache_k[i], cache_v[i], offsets) if block_tables is None
                  else (cache_k[i], cache_v[i], block_tables, offsets,
                        write_valid))
-            x, (k_i, v_i) = layer(x, None, c)
+            x, (k_i, v_i) = layer(x, None, c,
+                                  None if adapter is None else adapter[i])
             new_k.append(k_i)
             new_v.append(v_i)
         return self.head(x), (tuple(new_k), tuple(new_v))

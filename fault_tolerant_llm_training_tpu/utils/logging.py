@@ -217,3 +217,14 @@ AUDIT_FLEETSCOPE_TREND_REGRESSION_FMT = ("[FLEETSCOPE] Bench trend "
                                          "{metric} {delta_pct:+.1f}% "
                                          "({baseline} -> {current}, "
                                          "{direction} is better)")
+
+# --- Multi-tenant adapter serving audit trail (inference/adapters.py via
+# scheduler/serve/fleet) — one action-shaped line for the adapter pool's
+# lifecycle (page-in, evict, swap, reject) and a drain summary mirroring
+# the prefix-cache line. FROZEN; pinned by tests/test_audit_contract.py.
+AUDIT_ADAPTER_FMT = ("[ADAPTER] {action} adapter {name}: {pages} page(s), "
+                     "{detail}")
+AUDIT_ADAPTER_SUMMARY_FMT = ("[ADAPTER] drain summary | served {served} "
+                             "adapter(s) | page-ins {pageins} | evictions "
+                             "{evictions} | resident {resident_bytes} "
+                             "byte(s) | rejects {rejects}")

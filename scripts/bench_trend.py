@@ -80,6 +80,10 @@ PINNED: Dict[str, List[Tuple[str, str, str]]] = {
                                                "speedup"),
         ("partial_hit_rate", "higher", "sub-train partial prefix hit "
                                        "rate")],
+    "BENCH_adapter_serving_cpu.json": [
+        ("batched_vs_sequential_speedup", "higher",
+         "batched heterogeneous-adapter decode vs sequential "
+         "per-adapter serving at fixed pool bytes")],
 }
 
 

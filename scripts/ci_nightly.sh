@@ -124,6 +124,17 @@
 #    partially (rate > 0, deterministic and equal to the receipt), both
 #    lanes' streams and the partial-hit streams bit-exact, zero
 #    dropped, zero uninjected lane fallbacks.
+# 14. adapter serving — (a) adapter bench: re-runs the batched
+#    heterogeneous-adapter-decode vs sequential per-adapter scenario at
+#    a fixed adapter-pool byte budget and pins the
+#    BENCH_adapter_serving_cpu.json bars: batched beats sequential
+#    (> 1x; the magnitude is machine-dependent), every stream
+#    bit-matches its sequential single-tenant run, zero dropped; (b)
+#    adapter publish/reject drill: a CRC-manifested adapter artifact
+#    publishes through published.json's tenant->adapter sub-pointer and
+#    verifies green, then one flipped payload byte must fail
+#    verify_pointer naming the adapter AND be rejected at page-in with
+#    the adapter pool untouched.
 #
 # Runs on CPU in a few minutes (tiny models, synthetic data).
 set -euo pipefail
@@ -631,6 +642,103 @@ assert ok, "quantized decode parity check failed"
 print("ok: fused-dequant kernels within error bounds at D=64 and D=128")
 EOF
 
+echo "== adapter serving bench vs committed receipt"
+python scripts/decode_bench.py --scenario adapter_serving \
+    --out "$WORK/bench_adapter.json"
+python - "$WORK/bench_adapter.json" BENCH_adapter_serving_cpu.json <<'EOF'
+import json
+import sys
+
+got = json.load(open(sys.argv[1]))
+want = json.load(open(sys.argv[2]))
+speedup = got["batched_vs_sequential_speedup"]
+assert speedup > 1.0, (
+    f"heterogeneous batching bought nothing: batched/sequential wall "
+    f"ratio {speedup}x at fixed pool bytes")
+assert got["bit_exact"], (
+    "batched adapter streams diverged from their sequential "
+    "single-tenant runs — the fused adapter lane is no longer "
+    "bit-exact")
+assert got["dropped"] == 0, (
+    f"{got['dropped']} request(s) dropped across the modes")
+assert got["adapters"] >= 3 and got["pool_bytes"] == want["pool_bytes"], (
+    "the fixed-pool-budget comparison drifted from the receipt's "
+    "geometry")
+assert want["batched_vs_sequential_speedup"] > 1.0 \
+    and want["bit_exact"] and want["dropped"] == 0, (
+    "committed receipt is stale")
+print(f"ok: batched heterogeneous-adapter decode beats sequential "
+      f"per-adapter serving {speedup}x at fixed pool bytes "
+      f"({got['pool_bytes']} B, {got['adapters']} adapters + null, "
+      f"{got['requests']} requests), bit-exact, 0 dropped")
+EOF
+
+echo "== adapter publish/reject drill (verified sub-pointer, corrupt page-in)"
+ADPT_DIR="$WORK/adapter_drill"
+rm -rf "$ADPT_DIR"
+mkdir -p "$ADPT_DIR"
+python - "$ADPT_DIR" <<'EOF'
+import os
+import sys
+
+sys.path.insert(0, ".")
+root = sys.argv[1]
+
+from fault_tolerant_llm_training_tpu.checkpoint.manager import (
+    write_manifest)
+from fault_tolerant_llm_training_tpu.deploy.publish import (
+    Publisher, adapter_pointer, verify_pointer)
+from fault_tolerant_llm_training_tpu.inference.adapters import (
+    AdapterIntegrityError, AdapterLayout, AdapterManager,
+    init_adapter_factors, write_adapter_artifact)
+from fault_tolerant_llm_training_tpu.models.configs import get_config
+
+cfg = get_config("tiny", vocab_size=64, layer_impl="loop")
+layout = AdapterLayout.from_cfg(cfg, 4)
+
+step_dir = os.path.join(root, "checkpoint_pub", "20")
+os.makedirs(step_dir)
+with open(os.path.join(step_dir, "payload.bin"), "wb") as fh:
+    fh.write(b"weights" * 64)
+write_manifest(step_dir, 20)
+
+facts = init_adapter_factors(layout, seed=3, scale=0.5)
+ent = write_adapter_artifact(root, "tenant-a", 20, facts, rank=4,
+                             alpha=32.0)
+art = os.path.join(root, ent["path"])
+sub = adapter_pointer(root, "tenant-a", art)
+assert sub is not None and sub["rank"] == 4
+ptr = Publisher(root, "pub").publish(20, adapters={"tenant-a": sub})
+assert ptr is not None
+assert verify_pointer(root, ptr) == (True, "ok")
+print("ok: adapter artifact published as a tenant sub-pointer and "
+      "verified green (manifest digest + per-file CRC)")
+
+victim = sorted(f for f in os.listdir(art) if f.endswith(".npy"))[0]
+with open(os.path.join(art, victim), "r+b") as fh:
+    fh.seek(-1, os.SEEK_END)
+    b = fh.read(1)
+    fh.seek(-1, os.SEEK_END)
+    fh.write(bytes([b[0] ^ 0xFF]))
+ok, detail = verify_pointer(root, ptr)
+assert not ok and "adapter tenant-a" in detail, detail
+print("ok: one flipped payload byte fails verify-before-load naming "
+      "the adapter")
+
+written = []
+mgr = AdapterManager(layout, 2 * layout.pages_per_adapter + 1,
+                     lambda rows, pages: written.append(rows))
+mgr.register("tenant-a", art)
+try:
+    mgr.page_in("tenant-a")
+    raise AssertionError("corrupt artifact paged in")
+except AdapterIntegrityError:
+    pass
+assert mgr.allocator.used_count == 0 and not written
+print("ok: corrupt adapter rejected at page-in with the adapter pool "
+      "untouched (0 pages allocated, 0 pages written)")
+EOF
+
 echo "== fleet metrics federation drill (2 hosts -> rollups == per-host sums)"
 FED_DIR="$WORK/feddrill"
 rm -rf "$FED_DIR"
@@ -781,4 +889,4 @@ if ! grep -q "REGRESSION: BENCH_disagg_cpu.json value" "$SENT_DIR/verdict.txt"; 
 fi
 echo "ok: bench sentinel green on committed receipts, red (exit 3, metric named) on the synthetic regression"
 
-echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store, kv transport, federation drill, fleet post-mortem, bench sentinel)"
+echo "OK: nightly green (slow suite, chaos survival, fleet migration, tiered handoff+spill, prefix bench, fused decode, packed prefill, tree spec, serving latency, kv spill, kv quant + parity, disagg, fleet kv store, kv transport, adapter serving + publish drill, federation drill, fleet post-mortem, bench sentinel)"

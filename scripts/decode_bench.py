@@ -60,7 +60,8 @@ def main():
                             "shared_prefix", "fused_decode",
                             "mixed_prefill", "tree_spec", "serving_load",
                             "spill_preempt", "kv_quant", "disagg",
-                            "global_prefix", "transport"))
+                            "global_prefix", "transport",
+                            "adapter_serving"))
     p.add_argument("--burst-ns", default="1,4,8",
                    help="fused_decode scenario: comma-separated burst "
                         "lengths (tokens per dispatch) to sweep")
@@ -164,6 +165,8 @@ def main():
         result = _global_prefix(args, vocab)
     elif args.scenario == "transport":
         result = _transport(args, vocab)
+    elif args.scenario == "adapter_serving":
+        result = _adapter_serving(args, vocab)
     else:
         result = _uniform(args, build, reqs, backend)
     result["compile_cache"] = cache_dir if cache_on else ""
@@ -180,7 +183,8 @@ def main():
                     "kv_quant": "BENCH_kv_quant",
                     "disagg": "BENCH_disagg",
                     "global_prefix": "BENCH_kv_store",
-                    "transport": "BENCH_kv_transport"}.get(
+                    "transport": "BENCH_kv_transport",
+                    "adapter_serving": "BENCH_adapter_serving"}.get(
         args.scenario, f"BENCH_decode_{args.model}")
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -2173,6 +2177,153 @@ def _transport(args, vocab):
         },
         "partial_hit_rate": round(partial / fetches, 3) if fetches
         else 0.0,
+    }
+
+
+def _adapter_serving(args, vocab):
+    """Batched heterogeneous-adapter decode vs sequential per-adapter
+    serving at a FIXED adapter-pool byte budget.
+
+    K tenants' LoRA adapters (plus the null adapter — base-only traffic)
+    share one base model. The BATCHED mode serves all tenants' requests
+    through one scheduler: slots carrying DIFFERENT adapters batch into
+    the same fused decode dispatch, each gathering its own adapter pages
+    via its slot's page-table row. The SEQUENTIAL mode is what a
+    per-adapter deployment does at the same pool budget: one scheduler
+    pass per tenant, only that tenant's requests admitted, so the slot
+    batch runs mostly empty while every other tenant queues. Same
+    engine, same compiled programs, same resident pool — the ONLY
+    difference is whether heterogeneous adapters may share a dispatch.
+
+    Receipt bars (pinned by scripts/ci_nightly.sh and bench_trend):
+
+    - ``batched_vs_sequential_speedup`` > 1.0 — wall-time ratio at equal
+      pool bytes;
+    - ``bit_exact`` — every batched stream matches its sequential
+      single-tenant run token for token (and the null-adapter stream is
+      the base model's);
+    - ``dropped`` == 0 — both modes complete every request.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fault_tolerant_llm_training_tpu.inference.adapters import (
+        init_adapter_factors, write_adapter_artifact)
+    from fault_tolerant_llm_training_tpu.inference.engine import (
+        InferenceEngine)
+    from fault_tolerant_llm_training_tpu.inference.scheduler import (
+        Request, Scheduler)
+    from fault_tolerant_llm_training_tpu.models.configs import get_config
+    from fault_tolerant_llm_training_tpu.models.llama import Transformer
+    from fault_tolerant_llm_training_tpu.obs.registry import MetricRegistry
+
+    cfg = get_config(args.model, vocab_size=vocab,
+                     layer_impl=args.layer_impl)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, cfg.seq_len), jnp.int32))["params"]
+    rank, slots, repeats = 4, 4, 2
+    adapters = ("t0", "t1", "t2", "")  # three tenants + base-only lane
+
+    eng = InferenceEngine(cfg, params, slots=slots, max_len=64,
+                          prefill_buckets=(16,), kv_layout="paged",
+                          kv_block_size=8, adapter_rank=rank)
+    layout = eng._adapter_layout
+    pool_bytes = eng.adapter_num_pages * layout.page_elems * 4
+
+    root = tempfile.mkdtemp(prefix="bench_adapters_")
+    try:
+        for i, name in enumerate(a for a in adapters if a):
+            facts = init_adapter_factors(layout, seed=args.seed + 10 + i,
+                                         scale=0.5)
+            ent = write_adapter_artifact(root, name, 1, facts, rank=rank,
+                                         alpha=32.0)
+            eng.adapters.register(name, os.path.join(root, ent["path"]))
+
+        # two requests per tenant, mixed greedy/sampled — each tenant's
+        # streams are seeded, so batched and sequential runs must agree
+        wrng = np.random.default_rng(args.seed + 5)
+        requests = []
+        for i, name in enumerate(adapters * 2):
+            kw = ({} if i % 2 == 0 else {"temperature": 0.8,
+                                         "top_p": 0.9})
+            requests.append(Request(
+                id=f"r{i}", adapter=name,
+                prompt=wrng.integers(3, vocab,
+                                     size=8 + (i % 4) * 2).tolist(),
+                max_new_tokens=16, seed=700 + i, **kw))
+        n = len(requests)
+
+        def clone(r):
+            return Request(id=r.id, prompt=list(r.prompt),
+                           max_new_tokens=r.max_new_tokens,
+                           temperature=r.temperature, top_p=r.top_p,
+                           seed=r.seed, adapter=r.adapter)
+
+        def drive(reqs):
+            eng.reset()
+            sched = Scheduler(eng, eos_token_id=None,
+                              registry=MetricRegistry())
+            for r in reqs:
+                sched.submit(clone(r))
+            t0 = time.monotonic()
+            sched.run()
+            dt = time.monotonic() - t0
+            return ({c.request_id: c.tokens for c in sched.completed},
+                    dt, sched)
+
+        def run_batched():
+            return drive(requests)
+
+        def run_sequential():
+            streams, total = {}, 0.0
+            for name in adapters:
+                got, dt, _ = drive([r for r in requests
+                                    if r.adapter == name])
+                streams.update(got)
+                total += dt
+            return streams, total
+
+        run_batched()  # warmup: compiles + pages every adapter in
+        run_sequential()
+        bat_t, seq_t = float("inf"), float("inf")
+        for _ in range(repeats):
+            bat_streams, dt, bat_sched = run_batched()
+            bat_t = min(bat_t, dt)
+            seq_streams, dt = run_sequential()
+            seq_t = min(seq_t, dt)
+
+        bit_exact = bat_streams == seq_streams
+        tokens = sum(len(t) for t in bat_streams.values())
+        dropped = (n - len(bat_streams)) + (n - len(seq_streams))
+        am = bat_sched.metrics()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "scenario": "adapter_serving",
+        "model": args.model,
+        "slots": slots,
+        "adapter_rank": rank,
+        "adapters": len([a for a in adapters if a]),
+        "pool_pages": eng.adapter_num_pages,
+        "pool_bytes": pool_bytes,
+        "pages_per_adapter": layout.pages_per_adapter,
+        "requests": n,
+        "tokens": tokens,
+        "batched_seconds": round(bat_t, 4),
+        "sequential_seconds": round(seq_t, 4),
+        "batched_tok_per_s": round(tokens / bat_t, 2),
+        "sequential_tok_per_s": round(tokens / seq_t, 2),
+        "batched_vs_sequential_speedup": round(seq_t / bat_t, 3),
+        "adapter_pageins": int(am["adapter_pageins"]),
+        "adapter_evictions": int(am["adapter_evictions"]),
+        "bit_exact": bool(bit_exact),
+        "dropped": int(dropped),
     }
 
 

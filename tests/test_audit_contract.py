@@ -150,6 +150,12 @@ FROZEN = {
         "[FLEETSCOPE] Bench trend REGRESSION: {receipt} {metric} "
         "{delta_pct:+.1f}% ({baseline} -> {current}, {direction} is "
         "better)",
+    "AUDIT_ADAPTER_FMT":
+        "[ADAPTER] {action} adapter {name}: {pages} page(s), {detail}",
+    "AUDIT_ADAPTER_SUMMARY_FMT":
+        "[ADAPTER] drain summary | served {served} adapter(s) | "
+        "page-ins {pageins} | evictions {evictions} | resident "
+        "{resident_bytes} byte(s) | rejects {rejects}",
 }
 
 
